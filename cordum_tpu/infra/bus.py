@@ -1,0 +1,210 @@
+"""Message bus abstraction (the framework's NATS-equivalent).
+
+The control plane communicates through subjects carrying serialized
+``BusPacket`` envelopes.  Reference behavior being recreated
+(``core/infra/bus/nats.go``):
+
+  * queue groups: one subscriber per group receives each message; plain
+    subscriptions fan out
+  * wildcard subjects (``job.*``, ``sys.job.>``, ``worker.*.jobs``)
+  * durable subjects get at-least-once semantics: a handler raising
+    :class:`RetryAfter` triggers redelivery after the given delay (the
+    JetStream NAK-with-delay path, nats.go:154-163); other exceptions are
+    logged and acked (no redelivery)
+  * msg-id dedupe window: duplicate publishes of the same job/worker-scoped
+    message id inside the window are dropped (nats.go:404-435)
+
+Implementations: :class:`LoopbackBus` (in-process; also the integration-test
+bus, mirroring the reference's loopback test bus pattern,
+``scheduler/integration_test.go:18-46``) and the TCP statebus bus for
+multi-process deployments.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+from ..protocol import subjects as subj
+from ..protocol.types import BusPacket
+from ..utils.globmatch import subject_match
+
+log = logging.getLogger("cordum.bus")
+
+Handler = Callable[[str, BusPacket], Awaitable[None]]
+
+DEDUP_WINDOW_S = 120.0  # JetStream 2m dedup window equivalent
+MAX_REDELIVERIES = 5
+
+
+class RetryAfter(Exception):
+    """Raise from a durable-subject handler to request redelivery after a
+    delay (reference scheduler/retry.go:9-47)."""
+
+    def __init__(self, delay_s: float, reason: str = ""):
+        super().__init__(reason or f"retry after {delay_s}s")
+        self.delay_s = delay_s
+
+
+def compute_msg_id(subject: str, pkt: BusPacket) -> str:
+    """Stable message id for dedupe: explicit label override, else derived
+    from the payload's job/worker identity (reference nats.go:404-435)."""
+    p = pkt.payload
+    labels = getattr(p, "labels", None) or {}
+    if isinstance(labels, dict):
+        override = labels.get("cordum.bus_msg_id")
+        if override:
+            return f"{subject}|{override}"
+    job_id = getattr(p, "job_id", "")
+    if job_id:
+        return f"{subject}|{pkt.kind}|{job_id}"
+    worker_id = getattr(p, "worker_id", "")
+    if worker_id:
+        # heartbeats must not dedupe against each other: include time bucket
+        return f"{subject}|{pkt.kind}|{worker_id}|{pkt.created_at_us}"
+    return f"{subject}|{pkt.kind}|{pkt.trace_id}|{pkt.created_at_us}"
+
+
+@dataclass
+class _Subscription:
+    pattern: str
+    handler: Handler
+    queue: Optional[str]
+    sid: int
+    closed: bool = False
+
+
+class Bus:
+    """Async pub/sub interface."""
+
+    async def publish(self, subject: str, pkt: BusPacket) -> None:
+        raise NotImplementedError
+
+    async def subscribe(
+        self, pattern: str, handler: Handler, *, queue: Optional[str] = None
+    ) -> "Subscription":
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        return None
+
+    async def ping(self) -> bool:
+        return True
+
+
+class Subscription:
+    def __init__(self, unsub: Callable[[], None]):
+        self._unsub = unsub
+
+    def unsubscribe(self) -> None:
+        self._unsub()
+
+
+class LoopbackBus(Bus):
+    """In-process bus.
+
+    ``durable=True`` (default) gives at-least-once semantics on durable
+    subjects: delivery happens on background tasks, RetryAfter causes delayed
+    redelivery, and publishes are deduped by msg-id inside the window.
+    ``sync=True`` delivers inline in ``publish`` (deterministic unit tests).
+    """
+
+    def __init__(self, *, sync: bool = False, durable: bool = True):
+        self._subs: list[_Subscription] = []
+        self._sid = itertools.count(1)
+        self._rr: dict[tuple[str, str], int] = {}
+        self._sync = sync
+        self._durable = durable
+        self._dedup: dict[str, float] = {}
+        self._tasks: set[asyncio.Task] = set()
+        self._closed = False
+        self.published: list[tuple[str, BusPacket]] = []  # test observability
+
+    async def subscribe(
+        self, pattern: str, handler: Handler, *, queue: Optional[str] = None
+    ) -> Subscription:
+        sub = _Subscription(pattern, handler, queue, next(self._sid))
+        self._subs.append(sub)
+
+        def _unsub() -> None:
+            sub.closed = True
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+        return Subscription(_unsub)
+
+    def _targets(self, subject: str) -> list[_Subscription]:
+        matched = [s for s in self._subs if not s.closed and subject_match(s.pattern, subject)]
+        # collapse queue groups to one member (round-robin)
+        out: list[_Subscription] = []
+        groups: dict[tuple[str, str], list[_Subscription]] = {}
+        for s in matched:
+            if s.queue is None:
+                out.append(s)
+            else:
+                groups.setdefault((s.pattern, s.queue), []).append(s)
+        for key, members in groups.items():
+            i = self._rr.get(key, 0)
+            out.append(members[i % len(members)])
+            self._rr[key] = i + 1
+        return out
+
+    def _dedup_hit(self, subject: str, pkt: BusPacket) -> bool:
+        if not subj.is_durable_subject(subject):
+            return False
+        mid = compute_msg_id(subject, pkt)
+        now = time.monotonic()
+        # prune occasionally
+        if len(self._dedup) > 4096:
+            self._dedup = {k: t for k, t in self._dedup.items() if now - t < DEDUP_WINDOW_S}
+        seen = self._dedup.get(mid)
+        if seen is not None and now - seen < DEDUP_WINDOW_S:
+            return True
+        self._dedup[mid] = now
+        return False
+
+    async def publish(self, subject: str, pkt: BusPacket) -> None:
+        if self._closed:
+            return
+        if self._durable and self._dedup_hit(subject, pkt):
+            return
+        self.published.append((subject, pkt))
+        # round-trip through the wire format so both sides see the same shapes
+        wire = pkt.to_wire()
+        for sub in self._targets(subject):
+            decoded = BusPacket.from_wire(wire)
+            if self._sync:
+                await self._deliver(sub, subject, decoded)
+            else:
+                t = asyncio.ensure_future(self._deliver(sub, subject, decoded))
+                self._tasks.add(t)
+                t.add_done_callback(self._tasks.discard)
+
+    async def _deliver(
+        self, sub: _Subscription, subject: str, pkt: BusPacket, attempt: int = 1
+    ) -> None:
+        try:
+            await sub.handler(subject, pkt)
+        except RetryAfter as ra:
+            durable = self._durable and subj.is_durable_subject(subject)
+            if not durable or attempt >= MAX_REDELIVERIES or sub.closed or self._closed:
+                log.warning("dropping message on %s after %d attempts", subject, attempt)
+                return
+            await asyncio.sleep(ra.delay_s)
+            await self._deliver(sub, subject, pkt, attempt + 1)
+        except Exception:
+            log.exception("handler error on %s (acked; no redelivery)", subject)
+
+    async def drain(self) -> None:
+        """Wait for all in-flight async deliveries (tests)."""
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+
+    async def close(self) -> None:
+        self._closed = True
+        for t in list(self._tasks):
+            t.cancel()
+        self._subs.clear()

@@ -1,0 +1,136 @@
+"""Static configuration: env vars + YAML files.
+
+Three tiers as in the reference (SURVEY.md §5 "Config/flag system"):
+env (:func:`load`), YAML files (pools/timeouts here; safety policy lives in
+``controlplane.safetykernel.policy``), and the dynamic config service
+(:mod:`cordum_tpu.infra.configsvc`).
+
+TPU-first pools: a pool may declare ``requires`` (capabilities like ``tpu``),
+plus slice constraints — ``min_chips``, ``topology`` — that the slice-aware
+strategy checks against worker heartbeats (reference pools parser:
+``core/infra/config/pools.go:12-110``; TPU fields are the north-star
+extension from BASELINE.json).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import yaml
+
+
+@dataclass
+class Config:
+    statebus_url: str = ""
+    safety_kernel_addr: str = ""
+    pool_config_path: str = ""
+    timeout_config_path: str = ""
+    safety_policy_path: str = ""
+    context_engine_addr: str = ""
+    gateway_http_addr: str = "127.0.0.1:8081"
+    metrics_addr: str = ""
+    api_keys: list[str] = field(default_factory=list)
+    log_format: str = ""
+
+
+def load() -> Config:
+    env = os.environ
+    keys = [k.strip() for k in env.get("CORDUM_API_KEYS", env.get("CORDUM_API_KEY", "")).split(",") if k.strip()]
+    return Config(
+        statebus_url=env.get("CORDUM_STATEBUS_URL", ""),
+        safety_kernel_addr=env.get("SAFETY_KERNEL_ADDR", ""),
+        pool_config_path=env.get("POOL_CONFIG_PATH", "config/pools.yaml"),
+        timeout_config_path=env.get("TIMEOUT_CONFIG_PATH", "config/timeouts.yaml"),
+        safety_policy_path=env.get("SAFETY_POLICY_PATH", "config/safety.yaml"),
+        context_engine_addr=env.get("CONTEXT_ENGINE_ADDR", ""),
+        gateway_http_addr=env.get("GATEWAY_HTTP_ADDR", "127.0.0.1:8081"),
+        metrics_addr=env.get("METRICS_ADDR", ""),
+        api_keys=keys,
+        log_format=env.get("CORDUM_LOG_FORMAT", ""),
+    )
+
+
+# ---------------------------------------------------------------------------
+# pools
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Pool:
+    name: str
+    requires: list[str] = field(default_factory=list)
+    max_parallel_jobs: int = 0  # 0 = worker-reported
+    # TPU slice constraints (north-star: slice-aware routing over a v5p pod)
+    min_chips: int = 0
+    topology: str = ""  # e.g. "2x2x1"; empty = any
+    device_kind: str = ""  # e.g. "TPU v5p"; empty = any
+
+
+@dataclass
+class PoolConfig:
+    topics: dict[str, list[str]] = field(default_factory=dict)  # topic -> pool names
+    pools: dict[str, Pool] = field(default_factory=dict)
+
+    def pools_for_topic(self, topic: str) -> list[Pool]:
+        names = self.topics.get(topic, [])
+        return [self.pools[n] for n in names if n in self.pools]
+
+
+def parse_pool_config(doc: dict) -> PoolConfig:
+    cfg = PoolConfig()
+    for name, p in (doc.get("pools") or {}).items():
+        p = p or {}
+        cfg.pools[name] = Pool(
+            name=name,
+            requires=list(p.get("requires") or []),
+            max_parallel_jobs=int(p.get("max_parallel_jobs") or 0),
+            min_chips=int(p.get("min_chips") or 0),
+            topology=str(p.get("topology") or ""),
+            device_kind=str(p.get("device_kind") or ""),
+        )
+    for topic, pools in (doc.get("topics") or {}).items():
+        if isinstance(pools, str):
+            pools = [pools]
+        cfg.topics[topic] = list(pools or [])
+    return cfg
+
+
+def load_pool_config(path: str) -> PoolConfig:
+    if not os.path.exists(path):
+        # default: one pool, default topic routed to it
+        return parse_pool_config({"topics": {"job.default": "default"}, "pools": {"default": {}}})
+    with open(path) as f:
+        return parse_pool_config(yaml.safe_load(f) or {})
+
+
+# ---------------------------------------------------------------------------
+# timeouts
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Timeouts:
+    dispatch_timeout_s: float = 300.0
+    running_timeout_s: float = 9000.0
+    scan_interval_s: float = 30.0
+    per_workflow: dict[str, float] = field(default_factory=dict)
+    per_topic: dict[str, float] = field(default_factory=dict)
+
+
+def parse_timeouts(doc: dict) -> Timeouts:
+    t = Timeouts()
+    rec = doc.get("reconciler") or {}
+    t.dispatch_timeout_s = float(rec.get("dispatch_timeout_seconds", t.dispatch_timeout_s))
+    t.running_timeout_s = float(rec.get("running_timeout_seconds", t.running_timeout_s))
+    t.scan_interval_s = float(rec.get("scan_interval_seconds", t.scan_interval_s))
+    t.per_workflow = {k: float(v) for k, v in (doc.get("workflows") or {}).items()}
+    t.per_topic = {k: float(v) for k, v in (doc.get("topics") or {}).items()}
+    return t
+
+
+def load_timeouts(path: str) -> Timeouts:
+    if not os.path.exists(path):
+        return Timeouts()
+    with open(path) as f:
+        return parse_timeouts(yaml.safe_load(f) or {})

@@ -1,0 +1,61 @@
+"""Dead-letter queue store (reference ``core/infra/memory/dlq_store.go``)."""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.ids import now_us
+from .kv import KV
+
+
+@dataclass
+class DLQEntry:
+    job_id: str = ""
+    topic: str = ""
+    status: str = ""
+    reason: str = ""
+    reason_code: str = ""
+    last_state: str = ""
+    attempts: int = 0
+    tenant_id: str = ""
+    created_at_us: int = 0
+    labels: dict = field(default_factory=dict)
+
+
+def entry_key(job_id: str) -> str:
+    return f"dlq:entry:{job_id}"
+
+
+INDEX_KEY = "dlq:index"
+
+
+class DLQStore:
+    def __init__(self, kv: KV):
+        self.kv = kv
+
+    async def add(self, e: DLQEntry) -> None:
+        e.created_at_us = e.created_at_us or now_us()
+        await self.kv.set(entry_key(e.job_id), json.dumps(e.__dict__).encode())
+        await self.kv.zadd(INDEX_KEY, e.job_id, float(e.created_at_us))
+
+    async def get(self, job_id: str) -> Optional[DLQEntry]:
+        b = await self.kv.get(entry_key(job_id))
+        return DLQEntry(**json.loads(b)) if b else None
+
+    async def list(self, offset: int = 0, limit: int = 50) -> list[DLQEntry]:
+        ids = await self.kv.zrange(INDEX_KEY, offset, offset + limit - 1, desc=True)
+        out = []
+        for jid in ids:
+            e = await self.get(jid)
+            if e:
+                out.append(e)
+        return out
+
+    async def count(self) -> int:
+        return await self.kv.zcard(INDEX_KEY)
+
+    async def delete(self, job_id: str) -> bool:
+        n = await self.kv.delete(entry_key(job_id))
+        await self.kv.zrem(INDEX_KEY, job_id)
+        return n > 0

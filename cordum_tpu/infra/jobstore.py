@@ -1,0 +1,298 @@
+"""Job state store: atomic state machine + observability indexes.
+
+Recreates the semantics of the reference Redis job store
+(``core/infra/memory/job_store.go``, 1392 LoC):
+
+  * per-job metadata hash ``job:meta:<id>`` (~30 fields)
+  * optimistic (WATCH-equivalent) state transitions validated against the
+    legal-transition table (job_store.go:71-92) — illegal transitions fail,
+    terminal states are immutable
+  * per-state sorted-set indexes ``job:index:<STATE>``, plus ``job:recent``
+    and the ``job:deadline`` z-set scanned by the reconciler
+  * append-only per-job event log ``job:events:<id>`` and trace sets
+    ``trace:<id>`` (the tracing story — SURVEY.md §5)
+  * tenant active-job counts for concurrency limits
+  * scoped idempotency keys (SETNX), per-job locks (SETNX+TTL)
+  * safety-decision and approval records binding approvals to job hashes
+  * persisted JobRequest blobs so the pending replayer can resubmit
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..protocol.types import (
+    JobRequest,
+    JobState,
+    TERMINAL_STATES,
+    is_allowed_transition,
+)
+from ..utils.ids import now_us
+from .kv import KV
+
+DEFAULT_META_TTL_S = 7 * 24 * 3600.0
+RECENT_CAP = 10_000
+EVENTS_CAP = 200
+
+
+class IllegalTransition(Exception):
+    def __init__(self, job_id: str, prev: str, nxt: str):
+        super().__init__(f"job {job_id}: illegal transition {prev or '<none>'} -> {nxt}")
+        self.prev = prev
+        self.next = nxt
+
+
+def meta_key(job_id: str) -> str:
+    return f"job:meta:{job_id}"
+
+
+def index_key(state: str) -> str:
+    return f"job:index:{state}"
+
+
+def events_key(job_id: str) -> str:
+    return f"job:events:{job_id}"
+
+
+def trace_key(trace_id: str) -> str:
+    return f"trace:{trace_id}"
+
+
+def request_key(job_id: str) -> str:
+    return f"job:request:{job_id}"
+
+
+RECENT_KEY = "job:recent"
+DEADLINE_KEY = "job:deadline"
+
+
+@dataclass
+class SafetyDecisionRecord:
+    job_id: str = ""
+    decision: str = ""
+    reason: str = ""
+    rule_id: str = ""
+    policy_snapshot: str = ""
+    job_hash: str = ""
+    constraints: Optional[dict] = None
+    remediations: list[dict] = field(default_factory=list)
+    decided_at_us: int = 0
+
+
+@dataclass
+class ApprovalRecord:
+    job_id: str = ""
+    approved_by: str = ""
+    approved: bool = False
+    reason: str = ""
+    job_hash: str = ""
+    policy_snapshot: str = ""
+    decided_at_us: int = 0
+
+
+class JobStore:
+    def __init__(self, kv: KV, *, meta_ttl_s: float = DEFAULT_META_TTL_S):
+        self.kv = kv
+        self.meta_ttl_s = meta_ttl_s
+
+    # ------------------------------------------------------------------
+    # state machine
+    # ------------------------------------------------------------------
+    async def get_state(self, job_id: str) -> str:
+        v = await self.kv.hget(meta_key(job_id), "state")
+        return v.decode() if v else ""
+
+    async def get_meta(self, job_id: str) -> dict[str, str]:
+        h = await self.kv.hgetall(meta_key(job_id))
+        return {k: v.decode() for k, v in h.items()}
+
+    async def set_state(
+        self,
+        job_id: str,
+        state: JobState,
+        *,
+        fields: Optional[dict[str, str]] = None,
+        event: str = "",
+        max_retries: int = 16,
+    ) -> bool:
+        """Atomic validated transition.  Returns True if the state changed,
+        False if the job is already in ``state`` (idempotent re-apply).
+        Raises :class:`IllegalTransition` otherwise."""
+        key = meta_key(job_id)
+        for _ in range(max_retries):
+            ver = await self.kv.version(key)
+            h = await self.kv.hgetall(key)
+            prev = h.get("state", b"").decode()
+            if prev == state.value:
+                if fields:
+                    await self.kv.hset(key, {k: v.encode() for k, v in fields.items()})
+                return False
+            if not is_allowed_transition(prev, state):
+                raise IllegalTransition(job_id, prev, state.value)
+            ts = now_us()
+            mapping: dict[str, bytes] = {
+                "state": state.value.encode(),
+                "updated_at_us": str(ts).encode(),
+            }
+            if not h:
+                mapping["created_at_us"] = str(ts).encode()
+            if state in TERMINAL_STATES:
+                mapping["finished_at_us"] = str(ts).encode()
+            for k, v in (fields or {}).items():
+                mapping[k] = v.encode()
+            ops: list[tuple] = [("hset", key, mapping)]
+            if prev:
+                ops.append(("zrem", index_key(prev), job_id))
+            ops.append(("zadd", index_key(state.value), job_id, float(ts)))
+            ops.append(("zadd", RECENT_KEY, job_id, float(ts)))
+            ev = {
+                "ts_us": ts,
+                "state": state.value,
+                "prev": prev,
+                "event": event or f"state:{state.value}",
+            }
+            ops.append(("rpush", events_key(job_id), json.dumps(ev).encode()))
+            ops.append(("expire", key, self.meta_ttl_s))
+            if state in TERMINAL_STATES:
+                ops.append(("zrem", DEADLINE_KEY, job_id))
+                tenant = h.get("tenant_id", b"").decode()
+                if tenant and prev and prev not in (s.value for s in TERMINAL_STATES):
+                    ops.append(("zrem", f"job:tenant_active:{tenant}", job_id))
+            if await self.kv.commit({key: ver}, ops):
+                return True
+        raise RuntimeError(f"job {job_id}: transition to {state.value} lost race repeatedly")
+
+    async def set_fields(self, job_id: str, fields: dict[str, str]) -> None:
+        await self.kv.hset(meta_key(job_id), {k: v.encode() for k, v in fields.items()})
+        await self.kv.expire(meta_key(job_id), self.meta_ttl_s)
+
+    async def is_terminal(self, job_id: str) -> bool:
+        st = await self.get_state(job_id)
+        return bool(st) and st in (s.value for s in TERMINAL_STATES)
+
+    # ------------------------------------------------------------------
+    # indexes / listing
+    # ------------------------------------------------------------------
+    async def list_by_state(self, state: str, limit: int = 100) -> list[str]:
+        ids = await self.kv.zrange(index_key(state), 0, limit - 1 if limit else -1)
+        return ids
+
+    async def list_by_state_older_than(
+        self, state: str, cutoff_us: int, limit: int = 200
+    ) -> list[str]:
+        return await self.kv.zrangebyscore(index_key(state), 0, float(cutoff_us), limit=limit)
+
+    async def list_recent(self, limit: int = 100) -> list[str]:
+        return await self.kv.zrange(RECENT_KEY, 0, limit - 1, desc=True)
+
+    # ------------------------------------------------------------------
+    # deadlines
+    # ------------------------------------------------------------------
+    async def register_deadline(self, job_id: str, deadline_unix_ms: int) -> None:
+        await self.kv.zadd(DEADLINE_KEY, job_id, float(deadline_unix_ms))
+
+    async def expired_deadlines(self, now_ms: int, limit: int = 100) -> list[str]:
+        return await self.kv.zrangebyscore(DEADLINE_KEY, 0, float(now_ms), limit=limit)
+
+    async def clear_deadline(self, job_id: str) -> None:
+        await self.kv.zrem(DEADLINE_KEY, job_id)
+
+    # ------------------------------------------------------------------
+    # events / traces
+    # ------------------------------------------------------------------
+    async def append_event(self, job_id: str, event: str, **kw: Any) -> None:
+        ev = {"ts_us": now_us(), "event": event, **kw}
+        await self.kv.rpush(events_key(job_id), json.dumps(ev).encode())
+        await self.kv.ltrim(events_key(job_id), -EVENTS_CAP, -1)
+
+    async def events(self, job_id: str) -> list[dict]:
+        return [json.loads(b) for b in await self.kv.lrange(events_key(job_id))]
+
+    async def add_to_trace(self, trace_id: str, job_id: str) -> None:
+        if trace_id:
+            await self.kv.sadd(trace_key(trace_id), job_id)
+
+    async def trace(self, trace_id: str) -> set[str]:
+        return await self.kv.smembers(trace_key(trace_id))
+
+    # ------------------------------------------------------------------
+    # tenant concurrency
+    # ------------------------------------------------------------------
+    async def tenant_active_add(self, tenant_id: str, job_id: str) -> int:
+        key = f"job:tenant_active:{tenant_id}"
+        await self.kv.zadd(key, job_id, float(now_us()))
+        return await self.kv.zcard(key)
+
+    async def tenant_active_remove(self, tenant_id: str, job_id: str) -> None:
+        await self.kv.zrem(f"job:tenant_active:{tenant_id}", job_id)
+
+    async def tenant_active_count(self, tenant_id: str) -> int:
+        return await self.kv.zcard(f"job:tenant_active:{tenant_id}")
+
+    # ------------------------------------------------------------------
+    # idempotency + locks
+    # ------------------------------------------------------------------
+    async def try_set_idempotency_key(
+        self, scope: str, key: str, job_id: str, ttl_s: float = 24 * 3600
+    ) -> tuple[bool, str]:
+        """Reserve ``key`` in ``scope``; returns (reserved, existing_job_id)."""
+        k = f"idem:{scope}:{key}"
+        ok = await self.kv.setnx(k, job_id.encode(), ttl_s)
+        if ok:
+            return True, job_id
+        cur = await self.kv.get(k)
+        return False, cur.decode() if cur else ""
+
+    async def acquire_job_lock(self, job_id: str, owner: str, ttl_s: float = 30.0) -> bool:
+        return await self.kv.setnx(f"lock:job:{job_id}", owner.encode(), ttl_s)
+
+    async def release_job_lock(self, job_id: str, owner: str) -> None:
+        cur = await self.kv.get(f"lock:job:{job_id}")
+        if cur is not None and cur.decode() == owner:
+            await self.kv.delete(f"lock:job:{job_id}")
+
+    # ------------------------------------------------------------------
+    # persisted requests (for replays + approvals)
+    # ------------------------------------------------------------------
+    async def put_request(self, req: JobRequest) -> None:
+        await self.kv.set(request_key(req.job_id), req.to_wire(), self.meta_ttl_s)
+
+    async def get_request(self, job_id: str) -> Optional[JobRequest]:
+        b = await self.kv.get(request_key(job_id))
+        return JobRequest.from_wire(b) if b else None
+
+    # ------------------------------------------------------------------
+    # safety decisions + approvals
+    # ------------------------------------------------------------------
+    async def put_safety_decision(self, rec: SafetyDecisionRecord) -> None:
+        rec.decided_at_us = rec.decided_at_us or now_us()
+        await self.kv.set(
+            f"job:safety:{rec.job_id}", json.dumps(rec.__dict__).encode(), self.meta_ttl_s
+        )
+
+    async def get_safety_decision(self, job_id: str) -> Optional[SafetyDecisionRecord]:
+        b = await self.kv.get(f"job:safety:{job_id}")
+        return SafetyDecisionRecord(**json.loads(b)) if b else None
+
+    async def put_approval(self, rec: ApprovalRecord) -> None:
+        rec.decided_at_us = rec.decided_at_us or now_us()
+        await self.kv.set(
+            f"job:approval:{rec.job_id}", json.dumps(rec.__dict__).encode(), self.meta_ttl_s
+        )
+
+    async def get_approval(self, job_id: str) -> Optional[ApprovalRecord]:
+        b = await self.kv.get(f"job:approval:{job_id}")
+        return ApprovalRecord(**json.loads(b)) if b else None
+
+    # ------------------------------------------------------------------
+    async def cancel_job(self, job_id: str) -> bool:
+        """Move a non-terminal job to CANCELLED; False if terminal/unknown."""
+        st = await self.get_state(job_id)
+        if not st or st in (s.value for s in TERMINAL_STATES):
+            return False
+        try:
+            await self.set_state(job_id, JobState.CANCELLED, event="cancel")
+            return True
+        except IllegalTransition:
+            return False

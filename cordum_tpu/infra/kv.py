@@ -1,0 +1,430 @@
+"""State store abstraction (the framework's Redis-equivalent).
+
+All control-plane state lives behind this interface: job metadata hashes,
+state indexes (sorted sets), event logs (lists), pointers (``ctx:``/``res:``/
+``art:`` strings), locks (set-if-absent), and optimistic transactions
+(version-checked multi-key commits — the WATCH/MULTI equivalent the
+reference job store builds on, ``core/infra/memory/job_store.go``).
+
+Implementations:
+  * :class:`MemoryKV` — in-process asyncio store with TTLs and per-key
+    versions.  Used by tests (the miniredis analogue) and by single-process
+    deployments.
+  * ``cordum_tpu.infra.statebus.StateBusClient`` — TCP client to the
+    standalone statebus server for multi-process deployments.
+
+Pointer scheme: ``kv://<key>`` (reference uses ``redis://<key>``,
+``core/infra/memory/redis_store.go:139-158``).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Iterable, Optional
+
+POINTER_SCHEME = "kv://"
+
+
+def pointer_for_key(key: str) -> str:
+    return POINTER_SCHEME + key
+
+
+def key_from_pointer(ptr: str) -> str:
+    for scheme in (POINTER_SCHEME, "redis://"):
+        if ptr.startswith(scheme):
+            return ptr[len(scheme):]
+    return ptr
+
+
+class TxnConflict(Exception):
+    """Optimistic transaction lost the race; caller retries."""
+
+
+class KV:
+    """Async key-value interface.  Values are bytes; hashes map str->bytes."""
+
+    # strings -------------------------------------------------------------
+    async def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    async def set(self, key: str, value: bytes, ttl_s: Optional[float] = None) -> None:
+        raise NotImplementedError
+
+    async def setnx(self, key: str, value: bytes, ttl_s: Optional[float] = None) -> bool:
+        raise NotImplementedError
+
+    async def delete(self, *keys: str) -> int:
+        raise NotImplementedError
+
+    async def expire(self, key: str, ttl_s: float) -> bool:
+        raise NotImplementedError
+
+    async def keys(self, prefix: str = "") -> list[str]:
+        raise NotImplementedError
+
+    # hashes --------------------------------------------------------------
+    async def hset(self, key: str, mapping: dict[str, bytes]) -> None:
+        raise NotImplementedError
+
+    async def hget(self, key: str, field: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    async def hgetall(self, key: str) -> dict[str, bytes]:
+        raise NotImplementedError
+
+    async def hdel(self, key: str, *fields: str) -> int:
+        raise NotImplementedError
+
+    async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
+        raise NotImplementedError
+
+    # sorted sets ---------------------------------------------------------
+    async def zadd(self, key: str, member: str, score: float) -> None:
+        raise NotImplementedError
+
+    async def zrem(self, key: str, *members: str) -> int:
+        raise NotImplementedError
+
+    async def zrange(
+        self, key: str, start: int = 0, stop: int = -1, desc: bool = False
+    ) -> list[str]:
+        raise NotImplementedError
+
+    async def zrangebyscore(
+        self, key: str, min_score: float, max_score: float, limit: int = 0
+    ) -> list[str]:
+        raise NotImplementedError
+
+    async def zcard(self, key: str) -> int:
+        raise NotImplementedError
+
+    async def zscore(self, key: str, member: str) -> Optional[float]:
+        raise NotImplementedError
+
+    # lists ---------------------------------------------------------------
+    async def rpush(self, key: str, *values: bytes) -> int:
+        raise NotImplementedError
+
+    async def lrange(self, key: str, start: int = 0, stop: int = -1) -> list[bytes]:
+        raise NotImplementedError
+
+    async def ltrim(self, key: str, start: int, stop: int) -> None:
+        raise NotImplementedError
+
+    async def llen(self, key: str) -> int:
+        raise NotImplementedError
+
+    # sets ----------------------------------------------------------------
+    async def sadd(self, key: str, *members: str) -> int:
+        raise NotImplementedError
+
+    async def smembers(self, key: str) -> set[str]:
+        raise NotImplementedError
+
+    # transactions --------------------------------------------------------
+    async def version(self, key: str) -> int:
+        """Monotonic per-key version (bumped on every mutation); 0 if absent."""
+        raise NotImplementedError
+
+    async def commit(
+        self,
+        watches: dict[str, int],
+        ops: list[tuple],
+    ) -> bool:
+        """Atomically apply `ops` iff every watched key still has the given
+        version.  Each op is ``(method_name, *args)``.  Returns False on
+        conflict (the WATCH-abort equivalent)."""
+        raise NotImplementedError
+
+    async def ping(self) -> bool:
+        return True
+
+    async def close(self) -> None:
+        return None
+
+
+class _Entry:
+    __slots__ = ("value", "expires_at", "version")
+
+    def __init__(self, value: Any, expires_at: Optional[float], version: int):
+        self.value = value
+        self.expires_at = expires_at
+        self.version = version
+
+
+class MemoryKV(KV):
+    """In-process store with TTL and per-key version counters."""
+
+    def __init__(self) -> None:
+        self._data: dict[str, _Entry] = {}
+        self._lock = asyncio.Lock()
+        self._global_version = 0
+
+    # internal helpers (caller holds lock) --------------------------------
+    def _live(self, key: str) -> Optional[_Entry]:
+        e = self._data.get(key)
+        if e is None:
+            return None
+        if e.expires_at is not None and e.expires_at <= time.monotonic():
+            del self._data[key]
+            return None
+        return e
+
+    def _bump(self, key: str, value: Any, ttl_s: Optional[float] = None, keep_ttl: bool = False) -> _Entry:
+        self._global_version += 1
+        prev = self._data.get(key)
+        expires_at = None
+        if ttl_s is not None:
+            expires_at = time.monotonic() + ttl_s
+        elif keep_ttl and prev is not None:
+            expires_at = prev.expires_at
+        e = _Entry(value, expires_at, self._global_version)
+        self._data[key] = e
+        return e
+
+    # strings -------------------------------------------------------------
+    async def get(self, key: str) -> Optional[bytes]:
+        async with self._lock:
+            e = self._live(key)
+            return e.value if e is not None and isinstance(e.value, bytes) else None
+
+    async def set(self, key: str, value: bytes, ttl_s: Optional[float] = None) -> None:
+        async with self._lock:
+            self._set_op(key, value, ttl_s)
+
+    async def setnx(self, key: str, value: bytes, ttl_s: Optional[float] = None) -> bool:
+        async with self._lock:
+            if self._live(key) is not None:
+                return False
+            self._bump(key, value, ttl_s)
+            return True
+
+    async def delete(self, *keys: str) -> int:
+        async with self._lock:
+            return self._delete_op(*keys)
+
+    async def expire(self, key: str, ttl_s: float) -> bool:
+        async with self._lock:
+            e = self._live(key)
+            if e is None:
+                return False
+            e.expires_at = time.monotonic() + ttl_s
+            return True
+
+    async def keys(self, prefix: str = "") -> list[str]:
+        async with self._lock:
+            return [k for k in list(self._data) if self._live(k) is not None and k.startswith(prefix)]
+
+    # hashes --------------------------------------------------------------
+    async def hset(self, key: str, mapping: dict[str, bytes]) -> None:
+        async with self._lock:
+            self._hset_op(key, mapping)
+
+    async def hget(self, key: str, field: str) -> Optional[bytes]:
+        async with self._lock:
+            e = self._live(key)
+            if e is None or not isinstance(e.value, dict):
+                return None
+            return e.value.get(field)
+
+    async def hgetall(self, key: str) -> dict[str, bytes]:
+        async with self._lock:
+            e = self._live(key)
+            if e is None or not isinstance(e.value, dict):
+                return {}
+            return dict(e.value)
+
+    async def hdel(self, key: str, *fields: str) -> int:
+        async with self._lock:
+            e = self._live(key)
+            if e is None or not isinstance(e.value, dict):
+                return 0
+            n = 0
+            h = dict(e.value)
+            for f in fields:
+                if f in h:
+                    del h[f]
+                    n += 1
+            if n:
+                self._bump(key, h, keep_ttl=True)
+            return n
+
+    async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
+        async with self._lock:
+            e = self._live(key)
+            h = dict(e.value) if e is not None and isinstance(e.value, dict) else {}
+            cur = int(h.get(field, b"0")) + amount
+            h[field] = str(cur).encode()
+            self._bump(key, h, keep_ttl=True)
+            return cur
+
+    # sorted sets ---------------------------------------------------------
+    async def zadd(self, key: str, member: str, score: float) -> None:
+        async with self._lock:
+            self._zadd_op(key, member, score)
+
+    async def zrem(self, key: str, *members: str) -> int:
+        async with self._lock:
+            return self._zrem_op(key, *members)
+
+    async def zrange(self, key: str, start: int = 0, stop: int = -1, desc: bool = False) -> list[str]:
+        async with self._lock:
+            e = self._live(key)
+            if e is None or not isinstance(e.value, dict):
+                return []
+            items = sorted(e.value.items(), key=lambda kv: (kv[1], kv[0]), reverse=desc)
+            members = [m for m, _ in items]
+            if stop == -1:
+                return members[start:]
+            return members[start : stop + 1]
+
+    async def zrangebyscore(self, key: str, min_score: float, max_score: float, limit: int = 0) -> list[str]:
+        async with self._lock:
+            e = self._live(key)
+            if e is None or not isinstance(e.value, dict):
+                return []
+            items = sorted(
+                ((m, s) for m, s in e.value.items() if min_score <= s <= max_score),
+                key=lambda kv: (kv[1], kv[0]),
+            )
+            members = [m for m, _ in items]
+            return members[:limit] if limit else members
+
+    async def zcard(self, key: str) -> int:
+        async with self._lock:
+            e = self._live(key)
+            return len(e.value) if e is not None and isinstance(e.value, dict) else 0
+
+    async def zscore(self, key: str, member: str) -> Optional[float]:
+        async with self._lock:
+            e = self._live(key)
+            if e is None or not isinstance(e.value, dict):
+                return None
+            return e.value.get(member)
+
+    # lists ---------------------------------------------------------------
+    async def rpush(self, key: str, *values: bytes) -> int:
+        async with self._lock:
+            return self._rpush_op(key, *values)
+
+    async def lrange(self, key: str, start: int = 0, stop: int = -1) -> list[bytes]:
+        async with self._lock:
+            e = self._live(key)
+            if e is None or not isinstance(e.value, list):
+                return []
+            lst = e.value
+            if stop == -1:
+                return list(lst[start:] if start >= 0 else lst[start:])
+            if start < 0:
+                start = max(0, len(lst) + start)
+            return list(lst[start : stop + 1])
+
+    async def ltrim(self, key: str, start: int, stop: int) -> None:
+        async with self._lock:
+            e = self._live(key)
+            if e is None or not isinstance(e.value, list):
+                return
+            lst = e.value
+            if stop == -1:
+                new = lst[start:]
+            else:
+                new = lst[start : stop + 1]
+            self._bump(key, new, keep_ttl=True)
+
+    async def llen(self, key: str) -> int:
+        async with self._lock:
+            e = self._live(key)
+            return len(e.value) if e is not None and isinstance(e.value, list) else 0
+
+    # sets ----------------------------------------------------------------
+    async def sadd(self, key: str, *members: str) -> int:
+        async with self._lock:
+            e = self._live(key)
+            s = set(e.value) if e is not None and isinstance(e.value, set) else set()
+            n = len(set(members) - s)
+            s |= set(members)
+            self._bump(key, s, keep_ttl=True)
+            return n
+
+    async def smembers(self, key: str) -> set[str]:
+        async with self._lock:
+            e = self._live(key)
+            return set(e.value) if e is not None and isinstance(e.value, set) else set()
+
+    # transactions --------------------------------------------------------
+    async def version(self, key: str) -> int:
+        async with self._lock:
+            e = self._live(key)
+            return e.version if e is not None else 0
+
+    # op appliers used by commit(); all assume lock held
+    def _set_op(self, key: str, value: bytes, ttl_s: Optional[float] = None) -> None:
+        self._bump(key, value, ttl_s)
+
+    def _delete_op(self, *keys: str) -> int:
+        n = 0
+        for k in keys:
+            if self._live(k) is not None:
+                del self._data[k]
+                n += 1
+        return n
+
+    def _hset_op(self, key: str, mapping: dict[str, bytes]) -> None:
+        e = self._live(key)
+        h = dict(e.value) if e is not None and isinstance(e.value, dict) else {}
+        h.update(mapping)
+        self._bump(key, h, keep_ttl=True)
+
+    def _zadd_op(self, key: str, member: str, score: float) -> None:
+        e = self._live(key)
+        z = dict(e.value) if e is not None and isinstance(e.value, dict) else {}
+        z[member] = score
+        self._bump(key, z, keep_ttl=True)
+
+    def _zrem_op(self, key: str, *members: str) -> int:
+        e = self._live(key)
+        if e is None or not isinstance(e.value, dict):
+            return 0
+        z = dict(e.value)
+        n = 0
+        for m in members:
+            if m in z:
+                del z[m]
+                n += 1
+        if n:
+            self._bump(key, z, keep_ttl=True)
+        return n
+
+    def _rpush_op(self, key: str, *values: bytes) -> int:
+        e = self._live(key)
+        lst = list(e.value) if e is not None and isinstance(e.value, list) else []
+        lst.extend(values)
+        self._bump(key, lst, keep_ttl=True)
+        return len(lst)
+
+    def _expire_op(self, key: str, ttl_s: float) -> None:
+        e = self._live(key)
+        if e is not None:
+            e.expires_at = time.monotonic() + ttl_s
+
+    _OPS = {
+        "set": "_set_op",
+        "delete": "_delete_op",
+        "hset": "_hset_op",
+        "zadd": "_zadd_op",
+        "zrem": "_zrem_op",
+        "rpush": "_rpush_op",
+        "expire": "_expire_op",
+    }
+
+    async def commit(self, watches: dict[str, int], ops: list[tuple]) -> bool:
+        async with self._lock:
+            for key, ver in watches.items():
+                e = self._live(key)
+                cur = e.version if e is not None else 0
+                if cur != ver:
+                    return False
+            for op in ops:
+                name, *args = op
+                getattr(self, self._OPS[name])(*args)
+            return True

@@ -1,0 +1,147 @@
+"""Prometheus-style metrics: counters, gauges, histograms with text
+exposition (reference ``core/infra/metrics/metrics.go``).  Dependency-free;
+the gateway/scheduler serve ``render()`` at ``/metrics``."""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        if not self._values:
+            out.append(f"{self.name} 0")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", buckets: tuple = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = buckets
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """Approximate quantile from bucket boundaries (observability only)."""
+        key = tuple(sorted(labels.items()))
+        total = self._totals.get(key, 0)
+        if not total:
+            return None
+        target = q * total
+        counts = self._counts[key]
+        for i, c in enumerate(counts):
+            if c >= target:
+                return self.buckets[i]
+        return self.buckets[-1]
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key in sorted(self._totals):
+            labels = dict(key)
+            counts = self._counts[key]
+            for i, b in enumerate(self.buckets):
+                bl = dict(labels)
+                bl["le"] = repr(b)
+                out.append(f"{self.name}_bucket{_fmt_labels(bl)} {counts[i]}")
+            bl = dict(labels)
+            bl["le"] = "+Inf"
+            out.append(f"{self.name}_bucket{_fmt_labels(bl)} {self._totals[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {self._totals[key]}")
+        return out
+
+
+class Metrics:
+    """Shared metric families for the whole control plane."""
+
+    def __init__(self) -> None:
+        self.jobs_received = Counter("cordum_jobs_received_total", "Jobs received by scheduler")
+        self.jobs_dispatched = Counter("cordum_jobs_dispatched_total", "Jobs dispatched")
+        self.jobs_completed = Counter("cordum_jobs_completed_total", "Jobs reaching terminal state")
+        self.jobs_denied = Counter("cordum_jobs_safety_denied_total", "Jobs denied by safety kernel")
+        self.jobs_dlq = Counter("cordum_jobs_dlq_total", "Jobs dead-lettered")
+        self.http_requests = Counter("cordum_http_requests_total", "Gateway HTTP requests")
+        self.http_latency = Histogram("cordum_http_request_seconds", "Gateway HTTP latency")
+        self.dispatch_latency = Histogram(
+            "cordum_dispatch_seconds", "submit->dispatch latency"
+        )
+        self.e2e_latency = Histogram("cordum_job_e2e_seconds", "submit->result latency")
+        self.policy_evals = Counter("cordum_policy_evals_total", "Safety kernel evaluations")
+        self.workflow_steps = Counter("cordum_workflow_steps_total", "Workflow steps dispatched")
+        self.workers_live = Gauge("cordum_workers_live", "Live workers in registry")
+        self.tpu_duty_cycle = Gauge("cordum_tpu_duty_cycle", "Reported TPU duty cycle per worker")
+        self._families = [
+            self.jobs_received,
+            self.jobs_dispatched,
+            self.jobs_completed,
+            self.jobs_denied,
+            self.jobs_dlq,
+            self.http_requests,
+            self.http_latency,
+            self.dispatch_latency,
+            self.e2e_latency,
+            self.policy_evals,
+            self.workflow_steps,
+            self.workers_live,
+            self.tpu_duty_cycle,
+        ]
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for fam in self._families:
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n"

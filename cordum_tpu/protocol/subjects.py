@@ -1,0 +1,34 @@
+"""Bus subject constants (reference ``core/protocol/capsdk/constants.go:3-12``)."""
+from __future__ import annotations
+
+SUBMIT = "sys.job.submit"
+RESULT = "sys.job.result"
+HEARTBEAT = "sys.heartbeat"
+PROGRESS = "sys.job.progress"
+CANCEL = "sys.job.cancel"
+DLQ = "sys.job.dlq"
+WORKFLOW_EVENT = "sys.workflow.event"
+
+JOB_PREFIX = "job."
+WORKER_PREFIX = "worker."
+
+# Queue (consumer-group) names
+QUEUE_SCHEDULER = "cordum-scheduler"
+QUEUE_WORKFLOW_ENGINE = "cordum-workflow-engine"
+
+
+def direct_subject(worker_id: str) -> str:
+    """Direct worker-targeted delivery subject (reference bus/nats.go:94-99)."""
+    return f"worker.{worker_id}.jobs"
+
+
+def is_durable_subject(subject: str) -> bool:
+    """Subjects that get at-least-once semantics under the durable bus
+    (reference nats.go:369-381: submit/result/dlq/job.*/worker.*.jobs)."""
+    if subject in (SUBMIT, RESULT, DLQ):
+        return True
+    if subject.startswith(JOB_PREFIX):
+        return True
+    if subject.startswith(WORKER_PREFIX) and subject.endswith(".jobs"):
+        return True
+    return False

@@ -1,0 +1,495 @@
+"""Wire contract for the TPU control plane (CAP-v2-equivalent).
+
+The reference control plane speaks protobuf ``BusPacket`` envelopes from the
+external CAP module (see reference ``core/protocol/pb/v1/pb.go:1-78`` and
+``docs/AGENT_PROTOCOL.md`` "Wire Contracts").  We re-design the same contract
+as msgpack-serialized dataclasses: a ``BusPacket`` envelope with a tagged
+payload union of ``JobRequest / JobResult / Heartbeat / JobProgress /
+JobCancel / SystemAlert``, plus the safety-kernel ``PolicyCheck*`` pair.
+
+TPU-first deltas from the reference contract:
+  * ``Heartbeat`` reports TPU slice telemetry (``device_kind``, ``chip_count``,
+    ``slice_topology``, ``tpu_duty_cycle``, ``hbm_used_gb/hbm_total_gb``)
+    instead of ``gpu_utilization`` (reference Heartbeat fields documented in
+    ``docs/AGENT_PROTOCOL.md``).
+  * ``JobMetadata.requires`` can carry TPU constraints (``tpu``, ``chips:8``,
+    ``topology:2x2x2``) consumed by the slice-aware scheduler strategy.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import msgpack
+
+from ..utils.ids import new_id, now_us
+
+PROTOCOL_VERSION = 1
+
+
+class JobState(str, enum.Enum):
+    """Job lifecycle states (reference ``core/controlplane/scheduler`` states,
+    transition table at ``core/infra/memory/job_store.go:71-92``)."""
+
+    PENDING = "PENDING"
+    APPROVAL_REQUIRED = "APPROVAL_REQUIRED"
+    SCHEDULED = "SCHEDULED"
+    DISPATCHED = "DISPATCHED"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+    TIMEOUT = "TIMEOUT"
+    DENIED = "DENIED"
+
+
+TERMINAL_STATES = frozenset(
+    {
+        JobState.SUCCEEDED,
+        JobState.FAILED,
+        JobState.CANCELLED,
+        JobState.TIMEOUT,
+        JobState.DENIED,
+    }
+)
+
+# Legal state transitions; "" is the no-state-yet origin.
+# Mirrors reference job_store.go:71-92 semantics (not code).
+ALLOWED_TRANSITIONS: dict[str, frozenset[JobState]] = {
+    "": frozenset(
+        {
+            JobState.PENDING,
+            JobState.APPROVAL_REQUIRED,
+            JobState.SCHEDULED,
+            JobState.DISPATCHED,
+            JobState.RUNNING,
+            JobState.FAILED,
+        }
+    ),
+    JobState.PENDING: frozenset(
+        {
+            JobState.APPROVAL_REQUIRED,
+            JobState.SCHEDULED,
+            JobState.DISPATCHED,
+            JobState.RUNNING,
+            JobState.DENIED,
+            JobState.FAILED,
+            JobState.TIMEOUT,
+            JobState.CANCELLED,
+        }
+    ),
+    JobState.APPROVAL_REQUIRED: frozenset(
+        {
+            JobState.PENDING,
+            JobState.SCHEDULED,
+            JobState.DISPATCHED,
+            JobState.RUNNING,
+            JobState.DENIED,
+            JobState.FAILED,
+            JobState.TIMEOUT,
+            JobState.CANCELLED,
+        }
+    ),
+    JobState.SCHEDULED: frozenset(
+        {
+            JobState.DISPATCHED,
+            JobState.RUNNING,
+            JobState.DENIED,
+            JobState.FAILED,
+            JobState.TIMEOUT,
+            JobState.SUCCEEDED,
+            JobState.CANCELLED,
+        }
+    ),
+    JobState.DISPATCHED: frozenset(
+        {
+            JobState.RUNNING,
+            JobState.SUCCEEDED,
+            JobState.FAILED,
+            JobState.CANCELLED,
+            JobState.TIMEOUT,
+        }
+    ),
+    JobState.RUNNING: frozenset(
+        {
+            JobState.SUCCEEDED,
+            JobState.FAILED,
+            JobState.CANCELLED,
+            JobState.TIMEOUT,
+        }
+    ),
+    JobState.SUCCEEDED: frozenset(),
+    JobState.FAILED: frozenset(),
+    JobState.CANCELLED: frozenset(),
+    JobState.TIMEOUT: frozenset(),
+    JobState.DENIED: frozenset(),
+}
+
+
+def is_allowed_transition(prev: str | JobState, nxt: JobState) -> bool:
+    key = prev if prev in ALLOWED_TRANSITIONS else ""
+    if prev and prev not in ALLOWED_TRANSITIONS:
+        return False
+    return nxt in ALLOWED_TRANSITIONS[key]
+
+
+class Priority(str, enum.Enum):
+    INTERACTIVE = "INTERACTIVE"
+    BATCH = "BATCH"
+    CRITICAL = "CRITICAL"
+
+
+class Decision(str, enum.Enum):
+    """Safety-kernel decisions (reference safety_policy.go decision kinds)."""
+
+    ALLOW = "ALLOW"
+    DENY = "DENY"
+    REQUIRE_APPROVAL = "REQUIRE_APPROVAL"
+    ALLOW_WITH_CONSTRAINTS = "ALLOW_WITH_CONSTRAINTS"
+    THROTTLE = "THROTTLE"
+
+
+# ---------------------------------------------------------------------------
+# serde helpers
+# ---------------------------------------------------------------------------
+
+
+def _to_plain(v: Any) -> Any:
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return {
+            f.name: _to_plain(getattr(v, f.name))
+            for f in dataclasses.fields(v)
+            if getattr(v, f.name) is not None
+        }
+    if isinstance(v, enum.Enum):
+        return v.value
+    if isinstance(v, dict):
+        return {k: _to_plain(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_to_plain(x) for x in v]
+    return v
+
+
+class WireModel:
+    """Mixin: dict/msgpack serialization with unknown-field tolerance."""
+
+    def to_dict(self) -> dict[str, Any]:
+        return _to_plain(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None):
+        if d is None:
+            return None
+        kwargs: dict[str, Any] = {}
+        for f in dataclasses.fields(cls):  # type: ignore[arg-type]
+            if f.name not in d or d[f.name] is None:
+                continue
+            v = d[f.name]
+            conv = _NESTED.get((cls, f.name))
+            if conv is not None:
+                v = conv(v)
+            kwargs[f.name] = v
+        return cls(**kwargs)  # type: ignore[call-arg]
+
+    def to_wire(self) -> bytes:
+        return msgpack.packb(self.to_dict(), use_bin_type=True)
+
+    @classmethod
+    def from_wire(cls, b: bytes):
+        return cls.from_dict(msgpack.unpackb(b, raw=False))
+
+
+# ---------------------------------------------------------------------------
+# payload types
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ContextHints(WireModel):
+    max_input_tokens: int = 0
+    max_output_tokens: int = 0
+    mode: str = ""  # RAW | CHAT | RAG
+
+
+@dataclass
+class Budget(WireModel):
+    max_tokens: int = 0
+    max_cost_usd: float = 0.0
+    deadline_unix_ms: int = 0
+
+
+@dataclass
+class JobMetadata(WireModel):
+    """Policy/routing metadata (reference JobMetadata: capability, risk_tags,
+    requires, pack_id — docs/AGENT_PROTOCOL.md "Safety & Tenancy")."""
+
+    capability: str = ""
+    risk_tags: list[str] = field(default_factory=list)
+    requires: list[str] = field(default_factory=list)
+    pack_id: str = ""
+
+
+@dataclass
+class JobRequest(WireModel):
+    job_id: str = ""
+    topic: str = ""
+    priority: str = Priority.BATCH.value
+    context_ptr: str = ""
+    memory_id: str = ""
+    tenant_id: str = ""
+    principal_id: str = ""
+    adapter_id: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    env: dict[str, str] = field(default_factory=dict)
+    parent_job_id: str = ""
+    workflow_id: str = ""
+    run_id: str = ""
+    metadata: Optional[JobMetadata] = None
+    context_hints: Optional[ContextHints] = None
+    budget: Optional[Budget] = None
+
+
+@dataclass
+class JobResult(WireModel):
+    job_id: str = ""
+    status: str = JobState.SUCCEEDED.value
+    result_ptr: str = ""
+    worker_id: str = ""
+    execution_ms: int = 0
+    error_code: str = ""
+    error_message: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Heartbeat(WireModel):
+    """Worker heartbeat with TPU slice telemetry.
+
+    Reference Heartbeat carries worker_id/region/type/cpu_load/gpu_utilization/
+    active_jobs/capabilities/pool/max_parallel_jobs; the TPU-native shape keeps
+    the scheduler-visible fields and replaces GPU telemetry with TPU slice
+    health (SURVEY.md §5 "failure detection": add TPU-slice health).
+    """
+
+    worker_id: str = ""
+    region: str = ""
+    type: str = "tpu"
+    cpu_load: float = 0.0
+    tpu_duty_cycle: float = 0.0  # 0-100, MXU busy fraction
+    hbm_used_gb: float = 0.0
+    hbm_total_gb: float = 0.0
+    active_jobs: int = 0
+    max_parallel_jobs: int = 1
+    capabilities: list[str] = field(default_factory=list)
+    pool: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    device_kind: str = ""  # e.g. "TPU v5p"
+    chip_count: int = 0
+    slice_topology: str = ""  # e.g. "2x2x1"
+    devices_healthy: bool = True
+
+
+@dataclass
+class JobProgress(WireModel):
+    job_id: str = ""
+    percent: float = 0.0
+    message: str = ""
+    result_ptr: str = ""
+    artifact_ptrs: list[str] = field(default_factory=list)
+    status_hint: str = ""
+    worker_id: str = ""
+
+
+@dataclass
+class JobCancel(WireModel):
+    job_id: str = ""
+    reason: str = ""
+    requested_by: str = ""
+
+
+@dataclass
+class SystemAlert(WireModel):
+    severity: str = "info"
+    source: str = ""
+    message: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# safety kernel contract
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Constraints(WireModel):
+    """Execution constraints attached to ALLOW_WITH_CONSTRAINTS decisions.
+
+    TPU-native additions: max_chips / allowed_topologies bound what slice a
+    job may be placed on (reference constraints are budgets/sandbox/toolchain/
+    diff/redaction_level — config/safety_policy.go:13-146)."""
+
+    max_tokens: int = 0
+    max_cost_usd: float = 0.0
+    sandbox: str = ""
+    toolchain: str = ""
+    diff_limit: str = ""
+    redaction_level: str = ""
+    max_chips: int = 0
+    allowed_topologies: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Remediation(WireModel):
+    id: str = ""
+    description: str = ""
+    replacement_topic: str = ""
+    replacement_capability: str = ""
+    add_labels: dict[str, str] = field(default_factory=dict)
+    remove_labels: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PolicyCheckRequest(WireModel):
+    job_id: str = ""
+    tenant_id: str = ""
+    principal_id: str = ""
+    topic: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    metadata: Optional[JobMetadata] = None
+    actor_id: str = ""
+    actor_type: str = ""
+    effective_config: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class PolicyCheckResponse(WireModel):
+    decision: str = Decision.ALLOW.value
+    reason: str = ""
+    rule_id: str = ""
+    policy_snapshot: str = ""
+    approval_required: bool = False
+    approval_ref: str = ""
+    throttle_delay_s: float = 0.0
+    constraints: Optional[Constraints] = None
+    remediations: list[Remediation] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# envelope
+# ---------------------------------------------------------------------------
+
+_PAYLOAD_TYPES: dict[str, type] = {
+    "job_request": JobRequest,
+    "job_result": JobResult,
+    "heartbeat": Heartbeat,
+    "job_progress": JobProgress,
+    "job_cancel": JobCancel,
+    "system_alert": SystemAlert,
+}
+
+
+@dataclass
+class BusPacket(WireModel):
+    """Envelope for every bus message (reference BusPacket oneof payload)."""
+
+    trace_id: str = ""
+    sender_id: str = ""
+    created_at_us: int = 0
+    protocol_version: int = PROTOCOL_VERSION
+    kind: str = ""
+    payload: Any = None
+
+    @classmethod
+    def wrap(cls, payload: Any, *, trace_id: str = "", sender_id: str = "") -> "BusPacket":
+        kind = ""
+        for k, t in _PAYLOAD_TYPES.items():
+            if isinstance(payload, t):
+                kind = k
+                break
+        if not kind:
+            raise TypeError(f"unsupported payload type {type(payload)!r}")
+        return cls(
+            trace_id=trace_id or new_id(),
+            sender_id=sender_id,
+            created_at_us=now_us(),
+            kind=kind,
+            payload=payload,
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {
+            "trace_id": self.trace_id,
+            "sender_id": self.sender_id,
+            "created_at_us": self.created_at_us,
+            "protocol_version": self.protocol_version,
+            "kind": self.kind,
+        }
+        if self.payload is not None:
+            d["payload"] = _to_plain(self.payload)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None):
+        if d is None:
+            return None
+        kind = d.get("kind", "")
+        payload = d.get("payload")
+        if payload is not None and kind in _PAYLOAD_TYPES:
+            payload = _PAYLOAD_TYPES[kind].from_dict(payload)
+        return cls(
+            trace_id=d.get("trace_id", ""),
+            sender_id=d.get("sender_id", ""),
+            created_at_us=d.get("created_at_us", 0),
+            protocol_version=d.get("protocol_version", PROTOCOL_VERSION),
+            kind=kind,
+            payload=payload,
+        )
+
+    # typed accessors ------------------------------------------------------
+    @property
+    def job_request(self) -> Optional[JobRequest]:
+        return self.payload if self.kind == "job_request" else None
+
+    @property
+    def job_result(self) -> Optional[JobResult]:
+        return self.payload if self.kind == "job_result" else None
+
+    @property
+    def heartbeat(self) -> Optional[Heartbeat]:
+        return self.payload if self.kind == "heartbeat" else None
+
+    @property
+    def job_progress(self) -> Optional[JobProgress]:
+        return self.payload if self.kind == "job_progress" else None
+
+    @property
+    def job_cancel(self) -> Optional[JobCancel]:
+        return self.payload if self.kind == "job_cancel" else None
+
+    @property
+    def system_alert(self) -> Optional[SystemAlert]:
+        return self.payload if self.kind == "system_alert" else None
+
+
+# nested-field converters for WireModel.from_dict
+_NESTED: dict[tuple[type, str], Any] = {
+    (JobRequest, "metadata"): JobMetadata.from_dict,
+    (JobRequest, "context_hints"): ContextHints.from_dict,
+    (JobRequest, "budget"): Budget.from_dict,
+    (PolicyCheckRequest, "metadata"): JobMetadata.from_dict,
+    (PolicyCheckResponse, "constraints"): Constraints.from_dict,
+    (PolicyCheckResponse, "remediations"): lambda v: [
+        Remediation.from_dict(x) for x in v
+    ],
+}
+
+# Label key used by approvals / bus msg-id override
+LABEL_APPROVAL_GRANTED = "approval_granted"
+LABEL_APPROVAL_REF = "approval_ref"
+LABEL_BUS_MSG_ID = "cordum.bus_msg_id"
+LABEL_DRY_RUN = "cordum.dry_run"
+LABEL_SECRETS_PRESENT = "secrets_present"
+ENV_EFFECTIVE_CONFIG = "CORDUM_EFFECTIVE_CONFIG"

@@ -1,0 +1,23 @@
+"""ID and time helpers used across the control plane."""
+from __future__ import annotations
+
+import time
+import uuid
+
+
+def new_id() -> str:
+    """Random job/run/trace identifier (UUID4, canonical string form)."""
+    return str(uuid.uuid4())
+
+
+def now_us() -> int:
+    """Current wall time in microseconds (job-store timestamp unit)."""
+    return time.time_ns() // 1_000
+
+
+def now_s() -> float:
+    return time.time()
+
+
+def now_ms() -> int:
+    return time.time_ns() // 1_000_000

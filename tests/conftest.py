@@ -1,0 +1,40 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh so sharding tests run
+without TPU hardware (must be set before jax import anywhere)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio
+import inspect
+
+import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests with asyncio.run (no pytest-asyncio in env)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture
+def kv():
+    from cordum_tpu.infra.kv import MemoryKV
+
+    return MemoryKV()
+
+
+@pytest.fixture
+def bus():
+    from cordum_tpu.infra.bus import LoopbackBus
+
+    return LoopbackBus()

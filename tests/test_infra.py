@@ -1,0 +1,308 @@
+"""Infra-kernel tests: KV semantics, bus delivery, job store state machine,
+pointer store, DLQ, locks, artifacts, schema registry, configsvc, secrets."""
+import asyncio
+
+import pytest
+
+from cordum_tpu.infra.artifacts import ArtifactStore
+from cordum_tpu.infra.bus import LoopbackBus, RetryAfter, compute_msg_id
+from cordum_tpu.infra.configsvc import ConfigService
+from cordum_tpu.infra.dlq import DLQEntry, DLQStore
+from cordum_tpu.infra.jobstore import IllegalTransition, JobStore
+from cordum_tpu.infra.kv import MemoryKV, key_from_pointer, pointer_for_key
+from cordum_tpu.infra.locks import LockStore
+from cordum_tpu.infra.memstore import MemoryStore
+from cordum_tpu.infra.registry import WorkerRegistry
+from cordum_tpu.infra.schemareg import SchemaError, SchemaRegistry
+from cordum_tpu.infra.secrets import contains_secret_refs, redact_secret_refs
+from cordum_tpu.protocol.types import BusPacket, Heartbeat, JobRequest, JobState
+
+
+# ---------------------------------------------------------------- KV
+
+async def test_kv_basic(kv):
+    await kv.set("a", b"1")
+    assert await kv.get("a") == b"1"
+    assert await kv.setnx("a", b"2") is False
+    assert await kv.setnx("b", b"2") is True
+    assert await kv.delete("a", "b") == 2
+
+
+async def test_kv_ttl(kv):
+    await kv.set("a", b"1", ttl_s=0.02)
+    assert await kv.get("a") == b"1"
+    await asyncio.sleep(0.03)
+    assert await kv.get("a") is None
+
+
+async def test_kv_zset(kv):
+    await kv.zadd("z", "a", 3)
+    await kv.zadd("z", "b", 1)
+    await kv.zadd("z", "c", 2)
+    assert await kv.zrange("z") == ["b", "c", "a"]
+    assert await kv.zrange("z", desc=True) == ["a", "c", "b"]
+    assert await kv.zrangebyscore("z", 1, 2) == ["b", "c"]
+    assert await kv.zcard("z") == 3
+    await kv.zrem("z", "b")
+    assert await kv.zcard("z") == 2
+
+
+async def test_kv_list_hash(kv):
+    await kv.rpush("l", b"1", b"2", b"3")
+    assert await kv.lrange("l") == [b"1", b"2", b"3"]
+    assert await kv.lrange("l", -2) == [b"2", b"3"]
+    await kv.ltrim("l", -2, -1)
+    assert await kv.llen("l") == 2
+    await kv.hset("h", {"x": b"1"})
+    assert await kv.hget("h", "x") == b"1"
+    assert await kv.hincrby("h", "n", 5) == 5
+
+
+async def test_kv_commit_conflict(kv):
+    await kv.set("w", b"1")
+    ver = await kv.version("w")
+    assert await kv.commit({"w": ver}, [("set", "w", b"2")]) is True
+    # stale version now
+    assert await kv.commit({"w": ver}, [("set", "w", b"3")]) is False
+    assert await kv.get("w") == b"2"
+
+
+# ---------------------------------------------------------------- bus
+
+async def test_bus_queue_group_and_fanout():
+    bus = LoopbackBus(sync=True)
+    got_q, got_all = [], []
+
+    async def qh(name):
+        async def h(subject, pkt):
+            got_q.append(name)
+        return h
+
+    await bus.subscribe("sys.job.submit", await qh("a"), queue="g")
+    await bus.subscribe("sys.job.submit", await qh("b"), queue="g")
+
+    async def fan(subject, pkt):
+        got_all.append(subject)
+
+    await bus.subscribe("sys.job.>", fan)
+    for i in range(4):
+        await bus.publish("sys.job.submit", BusPacket.wrap(JobRequest(job_id=f"j{i}", topic="t")))
+    assert len(got_q) == 4  # one queue member per message
+    assert set(got_q) == {"a", "b"}  # round-robin hit both
+    assert len(got_all) == 4
+
+
+async def test_bus_retry_after_redelivers():
+    bus = LoopbackBus()
+    attempts = []
+
+    async def h(subject, pkt):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RetryAfter(0.01)
+
+    await bus.subscribe("sys.job.submit", h, queue="g")
+    await bus.publish("sys.job.submit", BusPacket.wrap(JobRequest(job_id="j1", topic="t")))
+    await bus.drain()
+    assert len(attempts) == 3
+
+
+async def test_bus_msg_id_dedupe():
+    bus = LoopbackBus()
+    got = []
+
+    async def h(subject, pkt):
+        got.append(pkt.job_request.job_id)
+
+    await bus.subscribe("sys.job.submit", h, queue="g")
+    req = JobRequest(job_id="same", topic="t")
+    await bus.publish("sys.job.submit", BusPacket.wrap(req))
+    await bus.publish("sys.job.submit", BusPacket.wrap(req))  # duplicate msg-id
+    await bus.drain()
+    assert got == ["same"]
+    # label override forces distinct ids
+    r2 = JobRequest(job_id="same", topic="t", labels={"cordum.bus_msg_id": "other"})
+    await bus.publish("sys.job.submit", BusPacket.wrap(r2))
+    await bus.drain()
+    assert len(got) == 2
+
+
+def test_msg_id_heartbeats_not_deduped():
+    hb = Heartbeat(worker_id="w1")
+    a = compute_msg_id("sys.heartbeat", BusPacket.wrap(hb))
+    b = compute_msg_id("sys.heartbeat", BusPacket.wrap(hb))
+    assert a != b  # time-bucketed
+
+
+# ---------------------------------------------------------------- job store
+
+async def test_jobstore_lifecycle(kv):
+    js = JobStore(kv)
+    await js.set_state("j1", JobState.PENDING, fields={"topic": "job.x", "tenant_id": "t"})
+    await js.set_state("j1", JobState.SCHEDULED)
+    await js.set_state("j1", JobState.DISPATCHED)
+    await js.set_state("j1", JobState.RUNNING)
+    await js.set_state("j1", JobState.SUCCEEDED, fields={"result_ptr": "kv://res:j1"})
+    meta = await js.get_meta("j1")
+    assert meta["state"] == "SUCCEEDED"
+    assert meta["result_ptr"] == "kv://res:j1"
+    assert "finished_at_us" in meta
+    assert await js.list_by_state("SUCCEEDED") == ["j1"]
+    assert await js.list_by_state("RUNNING") == []
+    assert "j1" in await js.list_recent()
+
+
+async def test_jobstore_illegal(kv):
+    js = JobStore(kv)
+    await js.set_state("j1", JobState.PENDING)
+    with pytest.raises(IllegalTransition):
+        await js.set_state("j1", JobState.SUCCEEDED)
+    await js.set_state("j1", JobState.RUNNING)
+    await js.set_state("j1", JobState.SUCCEEDED)
+    with pytest.raises(IllegalTransition):
+        await js.set_state("j1", JobState.RUNNING)  # terminal immutable
+    # idempotent re-apply returns False, no error
+    assert await js.set_state("j1", JobState.SUCCEEDED) is False
+
+
+async def test_jobstore_events_trace_deadline(kv):
+    js = JobStore(kv)
+    await js.set_state("j1", JobState.PENDING, event="submit")
+    await js.append_event("j1", "custom", detail="x")
+    evs = await js.events("j1")
+    assert evs[0]["event"] == "submit"
+    assert evs[-1]["detail"] == "x"
+    await js.add_to_trace("tr1", "j1")
+    assert await js.trace("tr1") == {"j1"}
+    await js.register_deadline("j1", 1000)
+    assert await js.expired_deadlines(2000) == ["j1"]
+    await js.clear_deadline("j1")
+    assert await js.expired_deadlines(2000) == []
+
+
+async def test_jobstore_idempotency_and_locks(kv):
+    js = JobStore(kv)
+    ok, jid = await js.try_set_idempotency_key("t1", "k", "j1")
+    assert ok and jid == "j1"
+    ok, jid = await js.try_set_idempotency_key("t1", "k", "j2")
+    assert not ok and jid == "j1"
+    ok, _ = await js.try_set_idempotency_key("t2", "k", "j3")  # scoped
+    assert ok
+    assert await js.acquire_job_lock("j1", "s1")
+    assert not await js.acquire_job_lock("j1", "s2")
+    await js.release_job_lock("j1", "s2")  # wrong owner: no-op
+    assert not await js.acquire_job_lock("j1", "s2")
+    await js.release_job_lock("j1", "s1")
+    assert await js.acquire_job_lock("j1", "s2")
+
+
+async def test_jobstore_request_persistence(kv):
+    js = JobStore(kv)
+    req = JobRequest(job_id="j1", topic="job.x", tenant_id="t")
+    await js.put_request(req)
+    back = await js.get_request("j1")
+    assert back.topic == "job.x"
+
+
+async def test_jobstore_tenant_counts(kv):
+    js = JobStore(kv)
+    await js.tenant_active_add("t", "j1")
+    await js.tenant_active_add("t", "j2")
+    assert await js.tenant_active_count("t") == 2
+    # terminal transition clears membership
+    await js.set_state("j1", JobState.PENDING, fields={"tenant_id": "t"})
+    await js.set_state("j1", JobState.RUNNING)
+    await js.set_state("j1", JobState.FAILED)
+    assert await js.tenant_active_count("t") == 1
+
+
+# ---------------------------------------------------------------- stores
+
+async def test_memstore_pointers(kv):
+    ms = MemoryStore(kv)
+    ptr = await ms.put_context("j1", {"input": "hi"})
+    assert ptr == "kv://ctx:j1"
+    assert await ms.get_context(ptr) == {"input": "hi"}
+    assert await ms.get_context("j1") == {"input": "hi"}
+    rptr = await ms.put_result("j1", {"out": 1})
+    assert await ms.get_pointer(rptr) == {"out": 1}
+    assert key_from_pointer("redis://ctx:x") == "ctx:x"  # legacy scheme accepted
+    assert pointer_for_key("res:j") == "kv://res:j"
+
+
+async def test_dlq(kv):
+    d = DLQStore(kv)
+    await d.add(DLQEntry(job_id="j1", topic="t", reason="boom", reason_code="FAILED"))
+    await d.add(DLQEntry(job_id="j2", topic="t", reason="denied"))
+    assert await d.count() == 2
+    entries = await d.list()
+    assert entries[0].job_id == "j2"  # newest first
+    assert await d.delete("j1")
+    assert await d.count() == 1
+
+
+async def test_locks(kv):
+    ls = LockStore(kv)
+    assert await ls.acquire("r1", "a", ttl_s=5)
+    assert not await ls.acquire("r1", "b")
+    assert await ls.acquire("r1", "a")  # re-entrant
+    assert await ls.release("r1", "a")
+    assert await ls.acquire("r1", "b", mode="shared")
+    assert await ls.acquire("r1", "c", mode="shared")
+    assert not await ls.acquire("r1", "d", mode="exclusive")
+    info = await ls.get("r1")
+    assert set(info.owners) == {"b", "c"}
+
+
+async def test_artifacts(kv):
+    a = ArtifactStore(kv)
+    meta = await a.put(b"hello", content_type="text/plain", retention="short")
+    data, m2 = await a.get(meta.artifact_id)
+    assert data == b"hello"
+    assert m2.content_type == "text/plain"
+    assert a.pointer(meta.artifact_id) == f"kv://art:{meta.artifact_id}"
+
+
+async def test_schema_registry(kv):
+    r = SchemaRegistry(kv)
+    await r.put("s1", {"type": "object", "required": ["x"]})
+    assert await r.validate_id("s1", {"x": 1}) == []
+    errs = await r.validate_id("s1", {})
+    assert errs
+    with pytest.raises(SchemaError):
+        await r.validate_id("missing", {})
+    assert "s1" in await r.list()
+
+
+async def test_configsvc_effective(kv):
+    c = ConfigService(kv)
+    await c.set("system", "default", {"a": 1, "b": 1})
+    await c.set("org", "acme", {"b": 2, "c": 2})
+    await c.set("workflow", "wf1", {"c": 3})
+    eff = await c.effective(org="acme", workflow="wf1")
+    assert eff == {"a": 1, "b": 2, "c": 3}
+    snap1 = await c.effective_snapshot(org="acme")
+    await c.patch("org", "acme", {"b": None, "d": 4})
+    doc = await c.get("org", "acme")
+    assert doc.revision == 2 and "b" not in doc.data and doc.data["d"] == 4
+    snap2 = await c.effective_snapshot(org="acme")
+    assert snap1["hash"] != snap2["hash"]
+
+
+def test_secrets():
+    v = {"key": "secret://vault/x", "nested": [{"a": "plain"}]}
+    assert contains_secret_refs(v)
+    red = redact_secret_refs(v)
+    assert red["key"] == "[redacted:secret-ref]"
+    assert red["nested"][0]["a"] == "plain"
+    assert not contains_secret_refs({"a": "b"})
+
+
+def test_registry_ttl():
+    reg = WorkerRegistry(ttl_s=0.0)  # everything instantly stale
+    reg.update(Heartbeat(worker_id="w1"))
+    assert reg.snapshot() == {}
+    reg2 = WorkerRegistry()
+    reg2.update(Heartbeat(worker_id="w1", active_jobs=2))
+    assert reg2.get("w1").active_jobs == 2
+    assert reg2.expire() == []

@@ -1,0 +1,94 @@
+"""Wire contract round-trips, state machine legality, job hashing."""
+import pytest
+
+from cordum_tpu.protocol.jobhash import job_hash
+from cordum_tpu.protocol.types import (
+    ALLOWED_TRANSITIONS,
+    BusPacket,
+    Constraints,
+    Heartbeat,
+    JobMetadata,
+    JobRequest,
+    JobResult,
+    JobState,
+    PolicyCheckResponse,
+    Remediation,
+    TERMINAL_STATES,
+    is_allowed_transition,
+)
+from cordum_tpu.utils.globmatch import glob_match, subject_match
+
+
+def test_packet_roundtrip():
+    req = JobRequest(
+        job_id="j1",
+        topic="job.tpu.matmul",
+        tenant_id="t1",
+        labels={"a": "b"},
+        metadata=JobMetadata(capability="tpu", requires=["tpu", "chips:4"]),
+    )
+    pkt = BusPacket.wrap(req, sender_id="gw")
+    decoded = BusPacket.from_wire(pkt.to_wire())
+    assert decoded.kind == "job_request"
+    assert decoded.job_request.job_id == "j1"
+    assert decoded.job_request.metadata.requires == ["tpu", "chips:4"]
+    assert decoded.trace_id == pkt.trace_id
+    assert decoded.protocol_version == 1
+
+
+def test_heartbeat_tpu_fields_roundtrip():
+    hb = Heartbeat(
+        worker_id="w1", chip_count=8, slice_topology="2x2x2", tpu_duty_cycle=42.5,
+        capabilities=["tpu"], pool="tpu-default",
+    )
+    d = BusPacket.from_wire(BusPacket.wrap(hb).to_wire()).heartbeat
+    assert d.chip_count == 8 and d.slice_topology == "2x2x2"
+    assert d.tpu_duty_cycle == pytest.approx(42.5)
+
+
+def test_policy_response_roundtrip():
+    resp = PolicyCheckResponse(
+        decision="ALLOW_WITH_CONSTRAINTS",
+        constraints=Constraints(max_chips=4, allowed_topologies=["2x2x1"]),
+        remediations=[Remediation(id="r1", replacement_topic="job.safe")],
+    )
+    d = PolicyCheckResponse.from_wire(resp.to_wire())
+    assert d.constraints.max_chips == 4
+    assert d.remediations[0].replacement_topic == "job.safe"
+
+
+def test_transition_table():
+    assert is_allowed_transition("", JobState.PENDING)
+    assert is_allowed_transition(JobState.PENDING, JobState.SCHEDULED)
+    assert is_allowed_transition(JobState.APPROVAL_REQUIRED, JobState.PENDING)
+    assert not is_allowed_transition(JobState.SUCCEEDED, JobState.RUNNING)
+    assert not is_allowed_transition(JobState.RUNNING, JobState.PENDING)
+    for terminal in TERMINAL_STATES:
+        assert not ALLOWED_TRANSITIONS[terminal]
+
+
+def test_job_hash_excludes_approval_labels():
+    req = JobRequest(job_id="j", topic="t", labels={"x": "1"})
+    h1 = job_hash(req)
+    req2 = JobRequest(job_id="j", topic="t", labels={"x": "1", "approval_granted": "true"})
+    assert job_hash(req2) == h1
+    req3 = JobRequest(job_id="j", topic="t", labels={"x": "2"})
+    assert job_hash(req3) != h1
+    req4 = JobRequest(job_id="j", topic="t", labels={"x": "1"}, env={"CORDUM_EFFECTIVE_CONFIG": "{}"})
+    assert job_hash(req4) == h1
+
+
+def test_subject_match():
+    assert subject_match("job.*", "job.default")
+    assert not subject_match("job.*", "job.a.b")
+    assert subject_match("sys.job.>", "sys.job.submit")
+    assert subject_match("worker.*.jobs", "worker.w1.jobs")
+    assert not subject_match("worker.*.jobs", "worker.w1.other")
+
+
+def test_glob_match():
+    assert glob_match("job.*", "job.echo")
+    assert not glob_match("job.*", "job.a.b")
+    assert glob_match("job.>", "job.a.b")
+    assert glob_match("deploy-*", "deploy-prod")
+    assert glob_match("*", "anything.at.all")
