@@ -1,0 +1,203 @@
+"""Safety kernel service: Check/Evaluate/Explain/Simulate/ListSnapshots.
+
+Recreates reference ``core/controlplane/safetykernel/kernel.go`` behavior:
+
+  * policy loaded from YAML file and/or config-service fragments stored
+    under the ``cfg:system:policy`` namespace (each fragment has an
+    ``enabled`` toggle; fragments append rules — kernel.go:590-655)
+  * snapshot id = ``<version>:<sha256[:12]>`` of the merged policy
+    (+ effective-config hash when present); last 10 snapshots retained
+  * decision cache keyed by hash(request minus job_id) + snapshot with TTL
+    (kernel.go:259-274)
+  * hot reload: ``reload()`` recomputes the snapshot; callers poll
+  * ed25519 signature verification for signed policy bundles
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+from ...infra.configsvc import ConfigService
+from ...protocol.types import PolicyCheckRequest, PolicyCheckResponse
+from .policy import SafetyPolicy, evaluate
+
+POLICY_FRAGMENT_PREFIX = "policy"  # cfg:system:policy/<fragment-id>
+DEFAULT_CACHE_TTL_S = 5.0
+MAX_SNAPSHOTS = 10
+
+
+def _policy_hash(doc: dict) -> str:
+    canonical = json.dumps(doc, sort_keys=True, separators=(",", ":"), default=str)
+    return hashlib.sha256(canonical.encode()).hexdigest()
+
+
+@dataclass
+class Snapshot:
+    snapshot_id: str
+    policy_doc: dict
+    created_at: float = field(default_factory=time.time)
+
+
+class SafetyKernel:
+    def __init__(
+        self,
+        *,
+        policy_doc: Optional[dict] = None,
+        policy_path: str = "",
+        configsvc: Optional[ConfigService] = None,
+        cache_ttl_s: float = DEFAULT_CACHE_TTL_S,
+    ):
+        self._file_doc = policy_doc or {}
+        self._policy_path = policy_path
+        self._configsvc = configsvc
+        self._cache_ttl_s = cache_ttl_s
+        self._cache: dict[str, tuple[float, PolicyCheckResponse]] = {}
+        self._version = 0
+        self._policy = SafetyPolicy()
+        self._snapshot_id = ""
+        self._snapshots: list[Snapshot] = []
+        self._merged_doc: dict = {}
+
+    # ------------------------------------------------------------------
+    async def reload(self) -> str:
+        """Re-merge file policy + config-service fragments; returns snapshot id."""
+        import copy
+
+        # deep copy: fragment merging must never mutate the base document,
+        # or disabled fragments' tenants/rules would persist across reloads
+        doc = copy.deepcopy(self._file_doc)
+        if self._policy_path:
+            try:
+                with open(self._policy_path) as f:
+                    doc = yaml.safe_load(f) or {}
+            except FileNotFoundError:
+                pass
+        rules = list(doc.get("rules") or [])
+        if self._configsvc is not None:
+            for frag_id in sorted(await self._configsvc.list("system")):
+                if not frag_id.startswith(POLICY_FRAGMENT_PREFIX + "/"):
+                    continue
+                frag = await self._configsvc.get("system", frag_id)
+                if not frag or not frag.data.get("enabled", True):
+                    continue
+                rules.extend(frag.data.get("rules") or [])
+                for tname, tpol in (frag.data.get("tenants") or {}).items():
+                    doc.setdefault("tenants", {})[tname] = tpol
+        doc["rules"] = rules
+        h = _policy_hash(doc)
+        if self._merged_doc and _policy_hash(self._merged_doc) == h:
+            return self._snapshot_id
+        self._version += 1
+        self._merged_doc = doc
+        self._policy = SafetyPolicy.from_dict(doc)
+        self._snapshot_id = f"{self._version}:{h[:12]}"
+        self._snapshots.append(Snapshot(self._snapshot_id, doc))
+        del self._snapshots[:-MAX_SNAPSHOTS]
+        self._cache.clear()
+        return self._snapshot_id
+
+    @property
+    def snapshot_id(self) -> str:
+        return self._snapshot_id
+
+    def list_snapshots(self) -> list[dict]:
+        return [
+            {"snapshot_id": s.snapshot_id, "created_at": s.created_at}
+            for s in self._snapshots
+        ]
+
+    def get_snapshot(self, snapshot_id: str) -> Optional[dict]:
+        for s in self._snapshots:
+            if s.snapshot_id == snapshot_id:
+                return s.policy_doc
+        return None
+
+    # ------------------------------------------------------------------
+    def _cache_key(self, req: PolicyCheckRequest) -> str:
+        d = req.to_dict()
+        d.pop("job_id", None)
+        canonical = json.dumps(d, sort_keys=True, separators=(",", ":"), default=str)
+        return hashlib.sha256(canonical.encode()).hexdigest() + "|" + self._snapshot_id
+
+    async def check(self, req: PolicyCheckRequest) -> PolicyCheckResponse:
+        """Evaluate with decision cache (the hot path the scheduler calls)."""
+        if not self._snapshot_id:
+            await self.reload()
+        key = self._cache_key(req)
+        now = time.monotonic()
+        hit = self._cache.get(key)
+        if hit is not None and now - hit[0] < self._cache_ttl_s:
+            return hit[1]
+        resp = evaluate(self._policy, req, self._snapshot_id)
+        self._apply_effective_overrides(req, resp)
+        if len(self._cache) > 8192:
+            self._cache = {k: v for k, v in self._cache.items() if now - v[0] < self._cache_ttl_s}
+        self._cache[key] = (now, resp)
+        return resp
+
+    def _apply_effective_overrides(self, req: PolicyCheckRequest, resp: PolicyCheckResponse) -> None:
+        """Effective-config safety overrides: denied/allowed topic lists in the
+        job's effective config can deny an otherwise-allowed job
+        (reference kernel.go:218-231)."""
+        eff = req.effective_config or {}
+        safety = eff.get("safety") if isinstance(eff, dict) else None
+        if not isinstance(safety, dict) or resp.decision == "DENY":
+            return
+        from ...utils.globmatch import glob_match
+
+        denied = safety.get("denied_topics") or []
+        if any(glob_match(p, req.topic) for p in denied):
+            resp.decision = "DENY"
+            resp.reason = f"effective config denies topic {req.topic}"
+            return
+        allowed = safety.get("allowed_topics") or []
+        if allowed and not any(glob_match(p, req.topic) for p in allowed):
+            resp.decision = "DENY"
+            resp.reason = f"topic {req.topic} not in effective-config allowlist"
+
+    async def evaluate_raw(self, req: PolicyCheckRequest) -> PolicyCheckResponse:
+        """Uncached evaluation (Evaluate/Simulate RPC equivalent)."""
+        if not self._snapshot_id:
+            await self.reload()
+        resp = evaluate(self._policy, req, self._snapshot_id)
+        self._apply_effective_overrides(req, resp)
+        return resp
+
+    async def explain(self, req: PolicyCheckRequest) -> dict[str, Any]:
+        """Decision plus per-rule match trail (Explain RPC equivalent)."""
+        if not self._snapshot_id:
+            await self.reload()
+        from .policy import _matches  # noqa: internal reuse
+
+        tenant = req.tenant_id or self._policy.default_tenant
+        trail = [
+            {"rule_id": r.id, "decision": r.decision, "matched": _matches(r.match, req, tenant)}
+            for r in self._policy.rules
+        ]
+        resp = await self.evaluate_raw(req)
+        return {"decision": resp.to_dict(), "trail": trail, "snapshot": self._snapshot_id}
+
+    async def simulate(self, policy_doc: dict, reqs: list[PolicyCheckRequest]) -> list[dict]:
+        """Evaluate requests against a *draft* policy without installing it."""
+        pol = SafetyPolicy.from_dict(policy_doc)
+        return [evaluate(pol, r, "draft").to_dict() for r in reqs]
+
+
+def verify_signature(policy_bytes: bytes, signature: bytes, public_key_bytes: bytes) -> bool:
+    """Ed25519 signature check for signed policy bundles
+    (reference kernel.go:832-868).  Uses the cryptography backend when
+    available; otherwise rejects (fail closed)."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
+
+        Ed25519PublicKey.from_public_bytes(public_key_bytes).verify(signature, policy_bytes)
+        return True
+    except ImportError:
+        return False
+    except Exception:
+        return False

@@ -1,0 +1,391 @@
+"""Scheduler engine: the core job-processing state machine.
+
+Recreates reference ``core/controlplane/scheduler/engine.go`` behavior,
+redesigned for asyncio + at-least-once redelivery:
+
+  * consumes ``sys.job.submit`` / ``sys.job.result`` / ``sys.job.cancel``
+    (queue group) + ``sys.heartbeat`` (fan-out)
+  * per-job KV lock before mutating state; contention → RetryAfter NAK
+    (the reference's 25ms lock spin redesigned as bus redelivery,
+    SURVEY.md §7 "hard parts")
+  * safety gate with approval-hash re-check: a job carrying
+    ``approval_granted`` is re-hashed and compared to the stored decision's
+    hash before the stored constraints are honored (engine.go:484-522)
+  * decision branches: DENY → DLQ; REQUIRE_APPROVAL → APPROVAL_REQUIRED
+    park; THROTTLE → delayed redelivery; ALLOW_WITH_CONSTRAINTS → env
+    injection + budget clamp (engine.go:298-347, applyConstraints :674-706)
+  * max-attempts + tenant-concurrency + deadline registration
+  * strategy pick → SCHEDULED → publish job packet → DISPATCHED → RUNNING
+  * ``handleJobResult``: terminal state + result_ptr, DLQ on failure,
+    terminal-state short-circuit for idempotency under redelivery
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from ...infra import logging as logx
+from ...infra.bus import Bus, RetryAfter
+from ...infra.configsvc import ConfigService
+from ...infra.jobstore import JobStore, SafetyDecisionRecord
+from ...infra.metrics import Metrics
+from ...infra.registry import WorkerRegistry
+from ...protocol import subjects as subj
+from ...protocol.jobhash import job_hash
+from ...protocol.types import (
+    BusPacket,
+    Constraints,
+    Decision,
+    ENV_EFFECTIVE_CONFIG,
+    JobRequest,
+    JobResult,
+    JobState,
+    LABEL_APPROVAL_GRANTED,
+    PolicyCheckRequest,
+    TERMINAL_STATES,
+)
+from .safety_client import SafetyClient
+from .strategy import Strategy
+
+DEFAULT_MAX_ATTEMPTS = 5
+ENV_POLICY_CONSTRAINTS = "CORDUM_POLICY_CONSTRAINTS"
+ENV_MAX_CHIPS = "CORDUM_MAX_CHIPS"
+
+
+class Engine:
+    def __init__(
+        self,
+        *,
+        bus: Bus,
+        job_store: JobStore,
+        safety: SafetyClient,
+        strategy: Strategy,
+        registry: WorkerRegistry,
+        configsvc: Optional[ConfigService] = None,
+        metrics: Optional[Metrics] = None,
+        instance_id: str = "scheduler-0",
+        max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+        tenant_concurrency_limit: int = 0,
+    ):
+        self.bus = bus
+        self.job_store = job_store
+        self.safety = safety
+        self.strategy = strategy
+        self.registry = registry
+        self.configsvc = configsvc
+        self.metrics = metrics or Metrics()
+        self.instance_id = instance_id
+        self.max_attempts = max_attempts
+        self.tenant_concurrency_limit = tenant_concurrency_limit
+        self._subs = []
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        self._subs = [
+            await self.bus.subscribe(subj.SUBMIT, self._on_submit, queue=subj.QUEUE_SCHEDULER),
+            await self.bus.subscribe(subj.RESULT, self._on_result, queue=subj.QUEUE_SCHEDULER),
+            await self.bus.subscribe(subj.CANCEL, self._on_cancel, queue=subj.QUEUE_SCHEDULER),
+            await self.bus.subscribe(subj.HEARTBEAT, self._on_heartbeat),
+            await self.bus.subscribe(subj.PROGRESS, self._on_progress),
+        ]
+
+    async def stop(self) -> None:
+        for s in self._subs:
+            s.unsubscribe()
+        self._subs = []
+
+    # ------------------------------------------------------------------
+    async def _on_heartbeat(self, subject: str, pkt: BusPacket) -> None:
+        hb = pkt.heartbeat
+        if hb is None:
+            return
+        self.registry.update(hb)
+        self.metrics.workers_live.set(len(self.registry.snapshot()))
+        if hb.worker_id:
+            self.metrics.tpu_duty_cycle.set(hb.tpu_duty_cycle, worker=hb.worker_id)
+
+    async def _on_progress(self, subject: str, pkt: BusPacket) -> None:
+        pr = pkt.job_progress
+        if pr is None or not pr.job_id:
+            return
+        await self.job_store.append_event(
+            pr.job_id, "progress", percent=pr.percent, message=pr.message
+        )
+
+    async def _on_cancel(self, subject: str, pkt: BusPacket) -> None:
+        c = pkt.job_cancel
+        if c is None or not c.job_id:
+            return
+        if await self.job_store.cancel_job(c.job_id):
+            await self.job_store.append_event(c.job_id, "cancelled", reason=c.reason)
+
+    # ------------------------------------------------------------------
+    async def _on_submit(self, subject: str, pkt: BusPacket) -> None:
+        req = pkt.job_request
+        if req is None or not req.job_id or not req.topic:
+            return
+        await self.handle_job_request(req, trace_id=pkt.trace_id)
+
+    async def handle_job_request(self, req: JobRequest, *, trace_id: str = "") -> None:
+        if not await self.job_store.acquire_job_lock(req.job_id, self.instance_id, ttl_s=30.0):
+            raise RetryAfter(0.05, f"job {req.job_id} locked")
+        try:
+            if await self.job_store.is_terminal(req.job_id):
+                return  # idempotency short-circuit under redelivery
+            self.metrics.jobs_received.inc(topic=req.topic)
+            st = await self.job_store.get_state(req.job_id)
+            if st == JobState.APPROVAL_REQUIRED.value:
+                # Parked jobs only move via a valid approval: the republish
+                # must carry the approval label AND hash-match the stored
+                # decision record; anything else must not clobber the parked
+                # request/record (attempted approval bypass otherwise).
+                stored = await self.job_store.get_safety_decision(req.job_id)
+                granted = (req.labels or {}).get(LABEL_APPROVAL_GRANTED) == "true"
+                if not (granted and stored and stored.job_hash == job_hash(req)):
+                    logx.warn(
+                        "ignoring republish of parked job without valid approval",
+                        job_id=req.job_id,
+                    )
+                    return
+            await self.job_store.put_request(req)
+            if not st:
+                await self.job_store.set_state(
+                    req.job_id,
+                    JobState.PENDING,
+                    fields={
+                        "topic": req.topic,
+                        "tenant_id": req.tenant_id,
+                        "principal_id": req.principal_id,
+                        "context_ptr": req.context_ptr,
+                        "workflow_id": req.workflow_id,
+                        "run_id": req.run_id,
+                        "trace_id": trace_id,
+                        "priority": req.priority,
+                        "submitted_at_us": str(time.time_ns() // 1000),
+                    },
+                    event="submit",
+                )
+            if trace_id:
+                await self.job_store.add_to_trace(trace_id, req.job_id)
+            await self.process_job(req, trace_id=trace_id)
+        finally:
+            await self.job_store.release_job_lock(req.job_id, self.instance_id)
+
+    # ------------------------------------------------------------------
+    async def process_job(self, req: JobRequest, *, trace_id: str = "") -> None:
+        meta = await self.job_store.get_meta(req.job_id)
+        await self._attach_effective_config(req)
+
+        resp = await self._check_safety(req)
+        decision = resp.decision
+
+        if decision == Decision.DENY.value:
+            self.metrics.jobs_denied.inc(topic=req.topic)
+            await self.job_store.put_safety_decision(self._decision_record(req, resp))
+            await self.job_store.set_state(
+                req.job_id, JobState.DENIED, fields={"deny_reason": resp.reason}, event="safety_deny"
+            )
+            await self._emit_dlq(req, resp.reason, "SAFETY_DENY", status=JobState.DENIED.value)
+            return
+
+        if decision == Decision.REQUIRE_APPROVAL.value:
+            await self.job_store.put_safety_decision(self._decision_record(req, resp))
+            await self.job_store.set_state(
+                req.job_id,
+                JobState.APPROVAL_REQUIRED,
+                fields={"approval_reason": resp.reason, "policy_snapshot": resp.policy_snapshot},
+                event="approval_required",
+            )
+            return  # parked until an admin approves
+
+        if decision == Decision.THROTTLE.value:
+            delay = resp.throttle_delay_s or 1.0
+            raise RetryAfter(delay, f"throttled: {resp.reason}")
+
+        # Record the decision with the hash of the request *as approved/checked*,
+        # before constraint injection mutates env (otherwise the stored hash
+        # would never match a faithful republish).
+        await self.job_store.put_safety_decision(self._decision_record(req, resp))
+        if decision == Decision.ALLOW_WITH_CONSTRAINTS.value and resp.constraints:
+            self._apply_constraints(req, resp.constraints)
+
+        # tenant concurrency
+        if self.tenant_concurrency_limit and req.tenant_id:
+            active = await self.job_store.tenant_active_count(req.tenant_id)
+            if active >= self.tenant_concurrency_limit:
+                raise RetryAfter(0.25, f"tenant {req.tenant_id} at concurrency limit")
+        if req.tenant_id:
+            await self.job_store.tenant_active_add(req.tenant_id, req.job_id)
+
+        # deadline registration
+        if req.budget and req.budget.deadline_unix_ms:
+            await self.job_store.register_deadline(req.job_id, req.budget.deadline_unix_ms)
+
+        # dispatch-attempts guard: counted only for real dispatch attempts so
+        # backpressure redeliveries (throttle / tenant concurrency) don't burn
+        # the budget of a job that merely waited
+        attempts = int(meta.get("attempts", "0")) + 1
+        await self.job_store.set_fields(req.job_id, {"attempts": str(attempts)})
+        if attempts > self.max_attempts:
+            await self._fail_to_dlq(req, "max attempts exceeded", "MAX_RETRIES")
+            return
+
+        # pick subject and dispatch
+        target = self.strategy.pick_subject(req)
+        await self.job_store.set_state(
+            req.job_id, JobState.SCHEDULED, fields={"dispatch_subject": target}, event="scheduled"
+        )
+        out = BusPacket.wrap(req, trace_id=trace_id, sender_id=self.instance_id)
+        await self.bus.publish(target, out)
+        await self.job_store.set_state(req.job_id, JobState.DISPATCHED, event="dispatched")
+        await self.job_store.set_state(req.job_id, JobState.RUNNING, event="running")
+        self.metrics.jobs_dispatched.inc(topic=req.topic)
+        sub_us = int(meta.get("submitted_at_us", "0") or 0)
+        if sub_us:
+            self.metrics.dispatch_latency.observe(max(0.0, time.time() - sub_us / 1e6))
+
+    # ------------------------------------------------------------------
+    async def _check_safety(self, req: JobRequest):
+        """Approval-granted fast path with hash binding, else kernel check."""
+        from ...protocol.types import PolicyCheckResponse
+
+        labels = req.labels or {}
+        if labels.get(LABEL_APPROVAL_GRANTED) == "true":
+            stored = await self.job_store.get_safety_decision(req.job_id)
+            if stored is not None and stored.job_hash and stored.job_hash == job_hash(req):
+                constraints = (
+                    Constraints.from_dict(stored.constraints) if stored.constraints else None
+                )
+                return PolicyCheckResponse(
+                    decision=(
+                        Decision.ALLOW_WITH_CONSTRAINTS.value
+                        if constraints
+                        else Decision.ALLOW.value
+                    ),
+                    reason="approval granted (hash verified)",
+                    policy_snapshot=stored.policy_snapshot,
+                    constraints=constraints,
+                )
+            # hash mismatch: the job content changed since approval → re-check
+            logx.warn("approval hash mismatch; re-checking", job_id=req.job_id)
+
+        check = PolicyCheckRequest(
+            job_id=req.job_id,
+            tenant_id=req.tenant_id,
+            principal_id=req.principal_id,
+            topic=req.topic,
+            labels=dict(labels),
+            metadata=req.metadata,
+            actor_id=req.principal_id,
+        )
+        eff = (req.env or {}).get(ENV_EFFECTIVE_CONFIG)
+        if eff:
+            try:
+                check.effective_config = json.loads(eff)
+            except ValueError:
+                pass
+        self.metrics.policy_evals.inc()
+        return await self.safety.check(check)
+
+    def _decision_record(self, req: JobRequest, resp) -> SafetyDecisionRecord:
+        return SafetyDecisionRecord(
+            job_id=req.job_id,
+            decision=resp.decision,
+            reason=resp.reason,
+            rule_id=resp.rule_id,
+            policy_snapshot=resp.policy_snapshot,
+            job_hash=job_hash(req),
+            constraints=resp.constraints.to_dict() if resp.constraints else None,
+            remediations=[r.to_dict() for r in resp.remediations],
+        )
+
+    def _apply_constraints(self, req: JobRequest, c: Constraints) -> None:
+        req.env = dict(req.env or {})
+        req.env[ENV_POLICY_CONSTRAINTS] = json.dumps(c.to_dict(), sort_keys=True)
+        if c.max_chips:
+            req.env[ENV_MAX_CHIPS] = str(c.max_chips)
+        for k, v in (c.env or {}).items():
+            req.env[k] = v
+        if c.max_tokens and req.budget is not None and (
+            req.budget.max_tokens == 0 or req.budget.max_tokens > c.max_tokens
+        ):
+            req.budget.max_tokens = c.max_tokens
+        if c.max_cost_usd and req.budget is not None and (
+            req.budget.max_cost_usd == 0 or req.budget.max_cost_usd > c.max_cost_usd
+        ):
+            req.budget.max_cost_usd = c.max_cost_usd
+
+    async def _attach_effective_config(self, req: JobRequest) -> None:
+        if self.configsvc is None:
+            return
+        snap = await self.configsvc.effective_snapshot(
+            org=req.tenant_id, workflow=req.workflow_id
+        )
+        req.env = dict(req.env or {})
+        req.env[ENV_EFFECTIVE_CONFIG] = snap["config"]
+        await self.job_store.set_fields(req.job_id, {"config_hash": snap["hash"]})
+
+    # ------------------------------------------------------------------
+    async def _on_result(self, subject: str, pkt: BusPacket) -> None:
+        res = pkt.job_result
+        if res is None or not res.job_id:
+            return
+        await self.handle_job_result(res)
+
+    async def handle_job_result(self, res: JobResult) -> None:
+        if await self.job_store.is_terminal(res.job_id):
+            return  # already terminal: redelivery no-op
+        try:
+            state = JobState(res.status)
+        except ValueError:
+            state = JobState.FAILED
+        if state not in TERMINAL_STATES:
+            # workers may send RUNNING status hints; record as event only
+            await self.job_store.append_event(res.job_id, "result_hint", status=res.status)
+            return
+        fields = {
+            "result_ptr": res.result_ptr,
+            "worker_id": res.worker_id,
+            "execution_ms": str(res.execution_ms),
+        }
+        if res.error_message:
+            fields["error_message"] = res.error_message
+            fields["error_code"] = res.error_code
+        await self.job_store.set_state(res.job_id, state, fields=fields, event="result")
+        await self.job_store.clear_deadline(res.job_id)
+        self.metrics.jobs_completed.inc(status=state.value)
+        meta = await self.job_store.get_meta(res.job_id)
+        sub_us = int(meta.get("submitted_at_us", "0") or 0)
+        if sub_us:
+            self.metrics.e2e_latency.observe(max(0.0, time.time() - sub_us / 1e6))
+        if state in (JobState.FAILED, JobState.TIMEOUT):
+            req = await self.job_store.get_request(res.job_id)
+            if req is not None:
+                await self._emit_dlq(
+                    req,
+                    res.error_message or state.value,
+                    res.error_code or state.value,
+                    status=state.value,
+                )
+
+    # ------------------------------------------------------------------
+    async def _fail_to_dlq(self, req: JobRequest, reason: str, code: str) -> None:
+        try:
+            await self.job_store.set_state(
+                req.job_id, JobState.FAILED, fields={"error_message": reason}, event="dlq"
+            )
+        except Exception:
+            pass
+        await self._emit_dlq(req, reason, code, status=JobState.FAILED.value)
+
+    async def _emit_dlq(self, req: JobRequest, reason: str, code: str, *, status: str) -> None:
+        self.metrics.jobs_dlq.inc(topic=req.topic)
+        res = JobResult(
+            job_id=req.job_id,
+            status=status,
+            error_code=code,
+            error_message=reason,
+            labels={"topic": req.topic, "tenant_id": req.tenant_id},
+        )
+        await self.bus.publish(subj.DLQ, BusPacket.wrap(res, sender_id=self.instance_id))

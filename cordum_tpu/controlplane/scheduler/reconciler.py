@@ -1,0 +1,181 @@
+"""Scheduler reconciler + pending replayer: the failure-detection loops.
+
+Reconciler (reference ``core/controlplane/scheduler/reconciler.go``): a
+singleton-behind-lock loop that (a) marks stale DISPATCHED/RUNNING jobs
+TIMEOUT past their configured timeouts (batched), and (b) expires
+``job:deadline`` entries.
+
+Pending replayer (reference ``pending_replayer.go``): re-drives PENDING
+jobs older than the dispatch timeout through the engine using the persisted
+JobRequest — unsticks submits lost to crashes between persist and dispatch.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ...infra import logging as logx
+from ...infra.config import Timeouts
+from ...infra.jobstore import IllegalTransition, JobStore
+from ...protocol.types import JobState
+from .engine import Engine
+
+BATCH = 200
+MAX_ITERATIONS = 100
+SINGLETON_LOCK = "lock:reconciler"
+
+
+class Reconciler:
+    def __init__(self, job_store: JobStore, timeouts: Timeouts, *, instance_id: str = "rec-0"):
+        self.job_store = job_store
+        self.timeouts = timeouts
+        self.instance_id = instance_id
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+
+    def update_timeouts(self, t: Timeouts) -> None:
+        self.timeouts = t
+
+    async def start(self) -> None:
+        self._stop.clear()
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.run_once()
+            except Exception:
+                logx.error("reconciler pass failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.timeouts.scan_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def run_once(self) -> int:
+        """One reconciliation pass; returns number of jobs timed out."""
+        # singleton guard: only one replica reconciles at a time
+        if not await self.job_store.kv.setnx(
+            SINGLETON_LOCK, self.instance_id.encode(), ttl_s=self.timeouts.scan_interval_s
+        ):
+            return 0
+        try:
+            n = 0
+            n += await self._timeout_state(JobState.DISPATCHED, self.timeouts.dispatch_timeout_s)
+            n += await self._timeout_state(JobState.RUNNING, self.timeouts.running_timeout_s)
+            n += await self._expire_deadlines()
+            return n
+        finally:
+            # owner-checked release: never delete another replica's lock
+            # (ours may have TTL-expired mid-pass and been re-acquired)
+            cur = await self.job_store.kv.get(SINGLETON_LOCK)
+            if cur is not None and cur.decode() == self.instance_id:
+                await self.job_store.kv.delete(SINGLETON_LOCK)
+
+    async def _timeout_state(self, state: JobState, timeout_s: float) -> int:
+        total = 0
+        cutoff_us = int((time.time() - timeout_s) * 1e6)
+        for _ in range(MAX_ITERATIONS):
+            stale = await self.job_store.list_by_state_older_than(state.value, cutoff_us, BATCH)
+            if not stale:
+                break
+            progressed = 0
+            for job_id in stale:
+                try:
+                    changed = await self.job_store.set_state(
+                        job_id,
+                        JobState.TIMEOUT,
+                        fields={"error_message": f"stale in {state.value} > {timeout_s}s"},
+                        event="reconciler_timeout",
+                    )
+                    if changed:
+                        total += 1
+                        progressed += 1
+                except IllegalTransition:
+                    progressed += 1  # moved on concurrently; index will catch up
+            if not progressed:
+                break
+        return total
+
+    async def _expire_deadlines(self) -> int:
+        now_ms = int(time.time() * 1000)
+        expired = await self.job_store.expired_deadlines(now_ms, limit=BATCH)
+        n = 0
+        for job_id in expired:
+            await self.job_store.clear_deadline(job_id)
+            if await self.job_store.is_terminal(job_id):
+                continue
+            try:
+                if await self.job_store.set_state(
+                    job_id,
+                    JobState.TIMEOUT,
+                    fields={"error_message": "deadline exceeded"},
+                    event="deadline_expired",
+                ):
+                    n += 1
+            except IllegalTransition:
+                pass
+        return n
+
+
+class PendingReplayer:
+    """Replays stuck PENDING / APPROVAL-released jobs through the engine."""
+
+    def __init__(self, engine: Engine, job_store: JobStore, timeouts: Timeouts):
+        self.engine = engine
+        self.job_store = job_store
+        self.timeouts = timeouts
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+
+    async def start(self) -> None:
+        self._stop.clear()
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.run_once()
+            except Exception:
+                logx.error("pending replayer pass failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.timeouts.scan_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def run_once(self) -> int:
+        cutoff_us = int((time.time() - self.timeouts.dispatch_timeout_s) * 1e6)
+        stuck = await self.job_store.list_by_state_older_than(
+            JobState.PENDING.value, cutoff_us, BATCH
+        )
+        n = 0
+        for job_id in stuck:
+            req = await self.job_store.get_request(job_id)
+            if req is None:
+                continue
+            try:
+                await self.engine.handle_job_request(req)
+                n += 1
+            except Exception:
+                logx.warn("replay failed", job_id=job_id)
+        return n

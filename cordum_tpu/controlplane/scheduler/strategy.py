@@ -1,0 +1,169 @@
+"""Dispatch-subject selection strategies.
+
+``LeastLoadedStrategy`` recreates the reference semantics
+(``core/controlplane/scheduler/strategy_least_loaded.go:40-262``) with
+TPU-slice awareness:
+
+  * topic → eligible pools from :class:`~cordum_tpu.infra.config.PoolConfig`
+  * pool eligibility: worker capabilities must cover the pool's ``requires``
+    *and* the job's own ``metadata.requires``; TPU constraints
+    (``chips:N``, ``topology:AxBxC``, pool ``min_chips``/``topology``/
+    ``device_kind``) are matched against heartbeat slice telemetry
+  * label hints: ``preferred_worker_id`` / ``preferred_pool``; placement
+    labels (``placement.<k>=<v>`` must equal the worker's label ``<k>``)
+  * overload skip: ≥90% of ``max_parallel_jobs``, or cpu ≥90, or TPU duty
+    cycle ≥90, or unhealthy devices
+  * score = ``active_jobs + cpu_load/100 + tpu_duty_cycle/100`` (reference
+    used gpu_utilization; TPU duty cycle is the analogue); least wins
+  * chosen worker → direct subject ``worker.<id>.jobs``; no worker →
+    topic fan-in subject (queue-group consumption)
+
+``update_routing`` atomically swaps the pool config (hot reload path).
+"""
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ...infra.config import Pool, PoolConfig
+from ...infra.registry import WorkerRegistry
+from ...protocol.subjects import direct_subject
+from ...protocol.types import Heartbeat, JobRequest
+
+_CHIPS_RE = re.compile(r"^chips:(\d+)$")
+_TOPOLOGY_RE = re.compile(r"^topology:([0-9x]+)$")
+
+OVERLOAD_FRACTION = 0.9
+OVERLOAD_UTIL = 90.0
+
+
+class Strategy:
+    def pick_subject(self, req: JobRequest) -> str:
+        raise NotImplementedError
+
+
+class NaiveStrategy(Strategy):
+    """Topic passthrough (reference strategy_naive.go)."""
+
+    def pick_subject(self, req: JobRequest) -> str:
+        return req.topic
+
+
+def _parse_tpu_requires(requires: list[str]) -> tuple[list[str], int, str]:
+    """Split requires into plain capabilities vs TPU constraints."""
+    caps: list[str] = []
+    min_chips = 0
+    topology = ""
+    for r in requires:
+        m = _CHIPS_RE.match(r)
+        if m:
+            min_chips = max(min_chips, int(m.group(1)))
+            continue
+        m = _TOPOLOGY_RE.match(r)
+        if m:
+            topology = m.group(1)
+            continue
+        caps.append(r)
+    return caps, min_chips, topology
+
+
+def worker_satisfies(
+    hb: Heartbeat, pool: Optional[Pool], job_requires: list[str]
+) -> bool:
+    caps = set(hb.capabilities)
+    req_caps, min_chips, topology = _parse_tpu_requires(job_requires)
+    if pool is not None:
+        pool_caps, pool_chips, pool_topology = _parse_tpu_requires(pool.requires)
+        req_caps += pool_caps
+        min_chips = max(min_chips, pool_chips, pool.min_chips)
+        topology = topology or pool_topology or pool.topology
+        if pool.device_kind and hb.device_kind and pool.device_kind != hb.device_kind:
+            return False
+    if not set(req_caps) <= caps:
+        return False
+    if min_chips and hb.chip_count < min_chips:
+        return False
+    if topology and hb.slice_topology and hb.slice_topology != topology:
+        return False
+    return True
+
+
+def is_overloaded(hb: Heartbeat) -> bool:
+    if not hb.devices_healthy:
+        return True
+    if hb.max_parallel_jobs > 0 and hb.active_jobs >= OVERLOAD_FRACTION * hb.max_parallel_jobs:
+        return True
+    if hb.cpu_load >= OVERLOAD_UTIL or hb.tpu_duty_cycle >= OVERLOAD_UTIL:
+        return True
+    return False
+
+
+def load_score(hb: Heartbeat) -> float:
+    return hb.active_jobs + hb.cpu_load / 100.0 + hb.tpu_duty_cycle / 100.0
+
+
+def _placement_labels(labels: dict[str, str]) -> dict[str, str]:
+    return {
+        k[len("placement."):]: v
+        for k, v in labels.items()
+        if k.startswith("placement.")
+    }
+
+
+class LeastLoadedStrategy(Strategy):
+    def __init__(self, registry: WorkerRegistry, pool_config: PoolConfig):
+        self.registry = registry
+        self._pool_config = pool_config
+
+    def update_routing(self, pool_config: PoolConfig) -> None:
+        self._pool_config = pool_config
+
+    def pick_subject(self, req: JobRequest) -> str:
+        labels = req.labels or {}
+        job_requires = list(req.metadata.requires) if req.metadata else []
+        workers = self.registry.snapshot()
+
+        pools = self._pool_config.pools_for_topic(req.topic)
+        placement = _placement_labels(labels)
+
+        # direct worker hint — still subject to capability/placement checks so
+        # a hint can never route a job to a worker that cannot run it
+        preferred_worker = labels.get("preferred_worker_id", "")
+        if preferred_worker:
+            hb = workers.get(preferred_worker)
+            if hb is not None and not is_overloaded(hb):
+                pool = next((p for p in pools if p.name == hb.pool), None) if pools else None
+                pool_ok = pool is not None or not pools
+                placement_ok = all(hb.labels.get(k) == v for k, v in placement.items())
+                if pool_ok and placement_ok and worker_satisfies(hb, pool, job_requires):
+                    return direct_subject(preferred_worker)
+        preferred_pool = labels.get("preferred_pool", "")
+        if preferred_pool:
+            hinted = [p for p in pools if p.name == preferred_pool]
+            if hinted:
+                pools = hinted
+
+        best_worker = ""
+        best_score = float("inf")
+        for hb in workers.values():
+            # pool membership: worker's reported pool must be one of the
+            # topic's pools (when the topic maps to pools at all)
+            pool: Optional[Pool] = None
+            if pools:
+                matched = [p for p in pools if p.name == hb.pool]
+                if not matched:
+                    continue
+                pool = matched[0]
+            if not worker_satisfies(hb, pool, job_requires):
+                continue
+            if placement and any(hb.labels.get(k) != v for k, v in placement.items()):
+                continue
+            if is_overloaded(hb):
+                continue
+            score = load_score(hb)
+            if score < best_score or (score == best_score and hb.worker_id < best_worker):
+                best_score = score
+                best_worker = hb.worker_id
+        if best_worker:
+            return direct_subject(best_worker)
+        return req.topic

@@ -60,7 +60,13 @@ def compute_msg_id(subject: str, pkt: BusPacket) -> str:
             return f"{subject}|{override}"
     job_id = getattr(p, "job_id", "")
     if job_id:
-        return f"{subject}|{pkt.kind}|{job_id}"
+        # approval republishes reuse the job_id on the submit subject and must
+        # NOT dedupe against the original submit, so the approval label is
+        # part of the identity
+        approved = ""
+        if isinstance(labels, dict) and labels.get("approval_granted") == "true":
+            approved = "|approved"
+        return f"{subject}|{pkt.kind}|{job_id}{approved}"
     worker_id = getattr(p, "worker_id", "")
     if worker_id:
         # heartbeats must not dedupe against each other: include time bucket
@@ -200,8 +206,11 @@ class LoopbackBus(Bus):
 
     async def drain(self) -> None:
         """Wait for all in-flight async deliveries (tests)."""
-        while self._tasks:
-            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+        while True:
+            pending = [t for t in list(self._tasks) if not t.done()]
+            if not pending:
+                break
+            await asyncio.gather(*pending, return_exceptions=True)
 
     async def close(self) -> None:
         self._closed = True

@@ -1,0 +1,217 @@
+"""Safety kernel: rule matching, first-match-wins, MCP gates, legacy tenant
+fallback, snapshots, decision cache, circuit breaker fail-closed."""
+import asyncio
+
+import pytest
+
+from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+from cordum_tpu.controlplane.safetykernel.policy import SafetyPolicy, evaluate
+from cordum_tpu.controlplane.scheduler.safety_client import CircuitBreaker, SafetyClient
+from cordum_tpu.infra.configsvc import ConfigService
+from cordum_tpu.protocol.types import JobMetadata, PolicyCheckRequest
+
+POLICY_YAML = """
+default_tenant: default
+tenants:
+  default:
+    allow_topics: ["job.*", "job.>"]
+    deny_topics: ["sys.*"]
+    mcp:
+      deny_servers: ["evil-*"]
+      allow_tools: ["search", "read_*"]
+rules:
+  - id: deny-prod-deploy
+    match:
+      topics: ["job.deploy.*"]
+      risk_tags: ["prod"]
+    decision: deny
+    reason: "prod deploys are blocked"
+  - id: approve-tpu-big
+    match:
+      capabilities: ["tpu"]
+      requires: ["chips:8"]
+    decision: require_approval
+    reason: "full-slice jobs need approval"
+  - id: constrain-tpu
+    match:
+      capabilities: ["tpu"]
+    decision: allow_with_constraints
+    constraints:
+      max_chips: 4
+      max_tokens: 1000
+      allowed_topologies: ["2x2x1"]
+  - id: throttle-batch
+    match:
+      labels: {"class": "bulk"}
+    decision: throttle
+    throttle_delay_s: 2.5
+"""
+
+
+def _policy():
+    return SafetyPolicy.from_yaml(POLICY_YAML)
+
+
+def test_first_match_wins_and_deny():
+    pol = _policy()
+    resp = evaluate(
+        pol,
+        PolicyCheckRequest(
+            topic="job.deploy.api",
+            metadata=JobMetadata(capability="tpu", risk_tags=["prod"], requires=["chips:8"]),
+        ),
+    )
+    assert resp.decision == "DENY"
+    assert resp.rule_id == "deny-prod-deploy"
+
+
+def test_require_approval_and_constraints():
+    pol = _policy()
+    resp = evaluate(
+        pol,
+        PolicyCheckRequest(topic="job.x", metadata=JobMetadata(capability="tpu", requires=["chips:8", "tpu"])),
+    )
+    assert resp.decision == "REQUIRE_APPROVAL" and resp.approval_required
+    resp2 = evaluate(
+        pol, PolicyCheckRequest(topic="job.x", metadata=JobMetadata(capability="tpu"))
+    )
+    assert resp2.decision == "ALLOW_WITH_CONSTRAINTS"
+    assert resp2.constraints.max_chips == 4
+    assert resp2.constraints.allowed_topologies == ["2x2x1"]
+
+
+def test_throttle_and_label_match():
+    resp = evaluate(_policy(), PolicyCheckRequest(topic="job.x", labels={"class": "bulk"}))
+    assert resp.decision == "THROTTLE"
+    assert resp.throttle_delay_s == pytest.approx(2.5)
+
+
+def test_legacy_tenant_fallback():
+    pol = _policy()
+    assert evaluate(pol, PolicyCheckRequest(topic="job.echo")).decision == "ALLOW"
+    assert evaluate(pol, PolicyCheckRequest(topic="sys.hack")).decision == "DENY"
+    assert evaluate(pol, PolicyCheckRequest(topic="other.thing")).decision == "DENY"
+
+
+def test_mcp_gates():
+    pol = _policy()
+    r = evaluate(pol, PolicyCheckRequest(topic="job.x", labels={"mcp.server": "evil-corp"}))
+    assert r.decision == "DENY" and "mcp" in r.reason
+    r2 = evaluate(pol, PolicyCheckRequest(topic="job.x", labels={"mcp.server": "ok", "mcp.tool": "read_file"}))
+    assert r2.decision == "ALLOW"
+    r3 = evaluate(pol, PolicyCheckRequest(topic="job.x", labels={"mcp.tool": "delete_everything"}))
+    assert r3.decision == "DENY"
+
+
+async def test_kernel_snapshots_and_fragments(kv):
+    import yaml
+
+    cs = ConfigService(kv)
+    kernel = SafetyKernel(policy_doc=yaml.safe_load(POLICY_YAML), configsvc=cs)
+    snap1 = await kernel.reload()
+    assert ":" in snap1
+    # adding an enabled policy fragment changes the snapshot
+    await cs.set(
+        "system",
+        "policy/extra-deny",
+        {"enabled": True, "rules": [{"id": "frag", "match": {"topics": ["job.frag"]}, "decision": "deny"}]},
+    )
+    snap2 = await kernel.reload()
+    assert snap2 != snap1
+    resp = await kernel.check(PolicyCheckRequest(topic="job.frag"))
+    assert resp.decision == "DENY" and resp.rule_id == "frag"
+    # disabled fragments are ignored
+    await cs.set("system", "policy/extra-deny", {"enabled": False, "rules": [{"id": "frag", "decision": "deny"}]})
+    await kernel.reload()
+    resp = await kernel.check(PolicyCheckRequest(topic="job.frag"))
+    assert resp.decision == "ALLOW"
+    assert len(kernel.list_snapshots()) == 3
+    assert kernel.get_snapshot(snap1) is not None
+
+
+async def test_kernel_decision_cache(kv):
+    import yaml
+
+    kernel = SafetyKernel(policy_doc=yaml.safe_load(POLICY_YAML))
+    await kernel.reload()
+    r1 = await kernel.check(PolicyCheckRequest(job_id="a", topic="job.x"))
+    r2 = await kernel.check(PolicyCheckRequest(job_id="b", topic="job.x"))
+    assert r2 is r1  # cache key excludes job_id
+
+
+async def test_kernel_effective_config_overrides():
+    kernel = SafetyKernel(policy_doc={})
+    await kernel.reload()
+    req = PolicyCheckRequest(topic="job.x", effective_config={"safety": {"denied_topics": ["job.x"]}})
+    assert (await kernel.check(req)).decision == "DENY"
+    req2 = PolicyCheckRequest(
+        topic="job.y", effective_config={"safety": {"allowed_topics": ["job.z"]}}
+    )
+    assert (await kernel.check(req2)).decision == "DENY"
+
+
+async def test_kernel_explain_and_simulate():
+    import yaml
+
+    kernel = SafetyKernel(policy_doc=yaml.safe_load(POLICY_YAML))
+    await kernel.reload()
+    exp = await kernel.explain(PolicyCheckRequest(topic="job.x", labels={"class": "bulk"}))
+    assert exp["decision"]["decision"] == "THROTTLE"
+    assert any(t["matched"] for t in exp["trail"])
+    sims = await kernel.simulate(
+        {"rules": [{"id": "d", "match": {"topics": ["job.*"]}, "decision": "deny"}]},
+        [PolicyCheckRequest(topic="job.x")],
+    )
+    assert sims[0]["decision"] == "DENY"
+
+
+# ---------------------------------------------------------------- client
+
+async def test_safety_client_fail_closed_and_breaker():
+    calls = []
+
+    async def failing(req):
+        calls.append(1)
+        raise RuntimeError("kernel down")
+
+    breaker = CircuitBreaker(fail_threshold=3, open_seconds=9999)
+    client = SafetyClient(failing, timeout_s=0.1, breaker=breaker)
+    for _ in range(3):
+        resp = await client.check(PolicyCheckRequest(topic="job.x"))
+        assert resp.decision == "DENY"
+    assert breaker.state == CircuitBreaker.OPEN
+    # circuit open: denies without calling the kernel
+    n = len(calls)
+    resp = await client.check(PolicyCheckRequest(topic="job.x"))
+    assert resp.decision == "DENY" and len(calls) == n
+
+
+async def test_safety_client_half_open_recovery():
+    ok = {"v": False}
+
+    async def flaky(req):
+        if not ok["v"]:
+            raise RuntimeError("down")
+        from cordum_tpu.protocol.types import PolicyCheckResponse
+
+        return PolicyCheckResponse(decision="ALLOW")
+
+    breaker = CircuitBreaker(fail_threshold=1, open_seconds=0.01, close_successes=2)
+    client = SafetyClient(flaky, breaker=breaker)
+    await client.check(PolicyCheckRequest(topic="t"))
+    assert breaker.state == CircuitBreaker.OPEN
+    await asyncio.sleep(0.02)
+    ok["v"] = True
+    r1 = await client.check(PolicyCheckRequest(topic="t"))
+    r2 = await client.check(PolicyCheckRequest(topic="t"))
+    assert r1.decision == "ALLOW" and r2.decision == "ALLOW"
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
+async def test_safety_client_timeout_denies():
+    async def slow(req):
+        await asyncio.sleep(1.0)
+
+    client = SafetyClient(slow, timeout_s=0.01)
+    resp = await client.check(PolicyCheckRequest(topic="t"))
+    assert resp.decision == "DENY" and "timed out" in resp.reason
