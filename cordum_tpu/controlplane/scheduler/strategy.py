@@ -83,7 +83,9 @@ def worker_satisfies(
         return False
     if min_chips and hb.chip_count < min_chips:
         return False
-    if topology and hb.slice_topology and hb.slice_topology != topology:
+    # a worker reporting no topology cannot satisfy a topology requirement
+    # (symmetric with the chips check above)
+    if topology and hb.slice_topology != topology:
         return False
     return True
 

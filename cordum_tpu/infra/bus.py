@@ -29,7 +29,7 @@ from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
 from ..protocol import subjects as subj
-from ..protocol.types import BusPacket
+from ..protocol.types import BusPacket, LABEL_APPROVAL_GRANTED, LABEL_BUS_MSG_ID
 from ..utils.globmatch import subject_match
 
 log = logging.getLogger("cordum.bus")
@@ -55,18 +55,24 @@ def compute_msg_id(subject: str, pkt: BusPacket) -> str:
     p = pkt.payload
     labels = getattr(p, "labels", None) or {}
     if isinstance(labels, dict):
-        override = labels.get("cordum.bus_msg_id")
+        override = labels.get(LABEL_BUS_MSG_ID)
         if override:
             return f"{subject}|{override}"
     job_id = getattr(p, "job_id", "")
     if job_id:
-        # approval republishes reuse the job_id on the submit subject and must
-        # NOT dedupe against the original submit, so the approval label is
-        # part of the identity
-        approved = ""
-        if isinstance(labels, dict) and labels.get("approval_granted") == "true":
-            approved = "|approved"
-        return f"{subject}|{pkt.kind}|{job_id}{approved}"
+        # Approval republishes reuse the job_id on the submit subject and must
+        # NOT dedupe against the original submit — nor against each other (a
+        # rejected tampered republish must not suppress the real approval),
+        # so they are time-bucketed instead.  The engine's terminal
+        # short-circuit + hash check make re-processing them idempotent.
+        if isinstance(labels, dict) and labels.get(LABEL_APPROVAL_GRANTED) == "true":
+            return f"{subject}|{pkt.kind}|{job_id}|approved|{pkt.created_at_us}"
+        # Results carry a status: a terminal result must not dedupe against an
+        # earlier non-terminal RUNNING hint for the same job.
+        status = getattr(p, "status", "")
+        if status:
+            return f"{subject}|{pkt.kind}|{job_id}|{status}"
+        return f"{subject}|{pkt.kind}|{job_id}"
     worker_id = getattr(p, "worker_id", "")
     if worker_id:
         # heartbeats must not dedupe against each other: include time bucket

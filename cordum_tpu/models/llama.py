@@ -1,0 +1,275 @@
+"""Llama-family decoder, TPU-first.
+
+This is the flagship model the TPU worker executes for inference jobs
+(BASELINE.json config #5: "Llama-3-8B JAX inference step behind safety-kernel
+REQUIRE_APPROVAL").  Design choices for the MXU/ICI:
+
+  * functional pytree params + pure ``forward`` — everything jits, no
+    framework indirection; params default to bfloat16 (MXU-native)
+  * GQA attention with RoPE, RMSNorm, SwiGLU — Llama-3 architecture family
+  * sharding by annotation: :func:`param_specs` gives the Megatron-style
+    tensor-parallel layout (column-parallel qkv/gate, row-parallel
+    out/down), activations are constrained to ``(dp, sp, ·)`` so long
+    sequences shard over the ``sp`` axis; XLA GSPMD inserts the ICI
+    collectives (all-gather for KV over ``sp``, psum for row-parallel
+    matmuls) — no hand-written NCCL-style code, per the scaling-book recipe
+  * static shapes, ``lax``-friendly: causal mask built with iota/compare,
+    no data-dependent Python control flow
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+Params = dict
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1536
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, rope_theta=500000.0, max_seq_len=8192,
+        )
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        return cls(vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=128)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    keys = jax.random.split(key, cfg.n_layers + 2)
+    d, h, kvh, hd, f = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff
+
+    def dense(k, shape, scale_dim):
+        return (jax.random.normal(k, shape, jnp.float32) / math.sqrt(scale_dim)).astype(cfg.dtype)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[i], 7)
+        layers.append(
+            {
+                "attn_norm": jnp.ones((d,), cfg.dtype),
+                "wq": dense(lk[0], (d, h * hd), d),
+                "wk": dense(lk[1], (d, kvh * hd), d),
+                "wv": dense(lk[2], (d, kvh * hd), d),
+                "wo": dense(lk[3], (h * hd, d), h * hd),
+                "mlp_norm": jnp.ones((d,), cfg.dtype),
+                "w_gate": dense(lk[4], (d, f), d),
+                "w_up": dense(lk[5], (d, f), d),
+                "w_down": dense(lk[6], (f, d), f),
+            }
+        )
+    return {
+        "embed": dense(keys[-2], (cfg.vocab_size, d), d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": dense(keys[-1], (d, cfg.vocab_size), d),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """Megatron-style TP layout as a PartitionSpec pytree."""
+    layer = {
+        "attn_norm": P(),
+        "wq": P(None, AXIS_TP),
+        "wk": P(None, AXIS_TP),
+        "wv": P(None, AXIS_TP),
+        "wo": P(AXIS_TP, None),
+        "mlp_norm": P(),
+        "w_gate": P(None, AXIS_TP),
+        "w_up": P(None, AXIS_TP),
+        "w_down": P(AXIS_TP, None),
+    }
+    return {
+        "embed": P(AXIS_TP, None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "final_norm": P(),
+        "lm_head": P(None, AXIS_TP),
+    }
+
+
+def shard_params(params: Params, cfg: LlamaConfig, mesh: Mesh) -> Params:
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, jnp.ndarray) or dataclasses.is_dataclass(x),
+    )
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * w
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x: [B, T, H, Dh], positions: [B, T]."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, Dh/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: LlamaConfig, *, causal: bool = True, q_offset=None):
+    """SDPA with GQA head expansion; fp32 softmax accumulation."""
+    b, tq, h, hd = q.shape
+    tk = k.shape[1]
+    rep = cfg.n_heads // cfg.n_kv_heads
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(hd)
+    if causal:
+        q_pos = jnp.arange(tq)[:, None] if q_offset is None else q_offset[:, :, None]
+        k_pos = jnp.arange(tk)[None, :]
+        mask = q_pos >= k_pos  # [Tq, Tk] or [B, Tq, Tk]
+        if mask.ndim == 2:
+            mask = mask[None, None, :, :]
+        else:
+            mask = mask[:, None, :, :]
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x, layer, cfg: LlamaConfig, positions, constrain):
+    b, t, d = x.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    attn_in = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (attn_in @ layer["wq"]).reshape(b, t, h, hd)
+    k = (attn_in @ layer["wk"]).reshape(b, t, kvh, hd)
+    v = (attn_in @ layer["wv"]).reshape(b, t, kvh, hd)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    # context parallelism (all-gather flavor): Q stays sequence-sharded over
+    # `sp`; K/V are constrained to full sequence, so GSPMD inserts the
+    # all-gather over the sp axis (SURVEY: "ring attention OR all-to-all
+    # sequence parallelism"; the ring variant lives in ops/ring_attention.py)
+    k = constrain(k, P(AXIS_DP, None, None, None))
+    v = constrain(v, P(AXIS_DP, None, None, None))
+    q_offset = positions  # absolute positions make causality correct under sp sharding
+    attn = _attention(q, k, v, cfg, q_offset=q_offset)
+    x = x + (attn.reshape(b, t, h * hd) @ layer["wo"])
+    x = constrain(x, P(AXIS_DP, AXIS_SP, None))
+
+    mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(mlp_in @ layer["w_gate"])
+    up = mlp_in @ layer["w_up"]
+    x = x + ((gate * up) @ layer["w_down"])
+    return constrain(x, P(AXIS_DP, AXIS_SP, None))
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Logits for next-token prediction; tokens: [B, T] int32 → [B, T, V]."""
+    if mesh is not None and AXIS_SP in mesh.axis_names:
+        def constrain(x, spec):
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    else:
+        def constrain(x, spec):  # single-device / no-mesh path
+            return x
+
+    b, t = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    x = params["embed"][tokens]  # gather; embed sharded over tp on vocab dim
+    x = constrain(x, P(AXIS_DP, AXIS_SP, None))
+    for layer in params["layers"]:
+        x = _block(x, layer, cfg, positions, constrain)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return x @ params["lm_head"]
+
+
+def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig, *, mesh=None) -> jax.Array:
+    """Next-token cross entropy over all positions but the last."""
+    logits = forward(params, tokens, cfg, mesh=mesh).astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+# ---------------------------------------------------------------------------
+# training step (used by the multi-chip dry run + training jobs)
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None):
+    """Build a jitted SPMD train step: params sharded per :func:`param_specs`,
+    batch over ``(dp, sp)``; gradients/optimizer states inherit param
+    shardings via jit output shardings."""
+    import optax
+
+    opt = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+    pspecs = param_specs(cfg)
+    param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
+    batch_sharding = NamedSharding(mesh, P(AXIS_DP, AXIS_SP))
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg, mesh=mesh))(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    jstep = jax.jit(
+        step,
+        in_shardings=(param_shardings, None, batch_sharding),
+        donate_argnums=(0, 1),
+    )
+
+    def init(key):
+        params = init_params(key, cfg)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, pspecs
+        )
+        opt_state = opt.init(params)
+        return params, opt_state
+
+    return init, jstep

@@ -1,0 +1,128 @@
+"""Device mesh + sharding helpers: the SPMD substrate for TPU workers.
+
+The reference has no in-process parallelism (NATS/Redis control plane only;
+SURVEY.md §2.4) — in the TPU-native design, every worker owns a slice and
+runs jobs as SPMD computations over a ``jax.sharding.Mesh``.  These helpers
+build meshes that match the physical slice, derive the topology string the
+worker reports in heartbeats, and provide the standard axis vocabulary:
+
+  * ``dp``   — data parallel (batch)
+  * ``tp``   — tensor/model parallel (MXU-heavy dims, rides ICI)
+  * ``sp``   — sequence/context parallel (long-context activations)
+  * ``ep``   — expert parallel (MoE routing)
+  * ``pp``   — pipeline parallel (layer stages)
+
+Meshes are created over whatever devices JAX exposes (TPU slice in prod,
+``xla_force_host_platform_device_count`` CPU devices in tests).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+AXIS_PP = "pp"
+
+
+@dataclass
+class MeshSpec:
+    """Logical mesh shape; -1 on one axis means "absorb remaining devices"."""
+
+    dp: int = -1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {"dp": self.dp, "tp": self.tp, "sp": self.sp, "ep": self.ep, "pp": self.pp}
+        fixed = math.prod(v for v in sizes.values() if v > 0)
+        free = [k for k, v in sizes.items() if v == -1]
+        if len(free) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if free:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[free[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+
+def build_mesh(
+    spec: MeshSpec | None = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+) -> Mesh:
+    """Build a named mesh over the devices.  Axes of size 1 are kept so the
+    same PartitionSpecs work at every scale (XLA drops trivial collectives)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(len(devs))
+    names = list(axis_names) if axis_names else [AXIS_DP, AXIS_TP, AXIS_SP, AXIS_EP, AXIS_PP]
+    shape = [sizes[n] for n in names]
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def simple_mesh(n_tp: int = 1, *, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The common dp×tp mesh: tp fixed, dp absorbs the rest."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if n % n_tp:
+        raise ValueError(f"{n} devices not divisible by tp={n_tp}")
+    arr = np.array(devs).reshape(n // n_tp, n_tp)
+    return Mesh(arr, axis_names=(AXIS_DP, AXIS_TP))
+
+
+def slice_topology(devices: Optional[Sequence[jax.Device]] = None) -> str:
+    """Physical topology string for heartbeats (e.g. ``2x2x1``); falls back
+    to a flat ``N`` chip count when coords are unavailable (CPU backend)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    coords = [getattr(d, "coords", None) for d in devs]
+    if any(c is None for c in coords):
+        return str(len(devs))
+    dims = len(coords[0])
+    extents = [len({c[i] for c in coords}) for i in range(dims)]
+    return "x".join(str(e) for e in extents)
+
+
+def device_kind(devices: Optional[Sequence[jax.Device]] = None) -> str:
+    devs = list(devices) if devices is not None else list(jax.devices())
+    return devs[0].device_kind if devs else ""
+
+
+def hbm_stats(devices: Optional[Sequence[jax.Device]] = None) -> tuple[float, float]:
+    """(used_gb, total_gb) summed over devices; (0,0) when unsupported."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    used = total = 0.0
+    for d in devs:
+        try:
+            st = d.memory_stats()
+        except Exception:
+            return 0.0, 0.0
+        if not st:
+            return 0.0, 0.0
+        used += st.get("bytes_in_use", 0) / 1e9
+        total += st.get("bytes_limit", st.get("bytes_reservable_limit", 0)) / 1e9
+    return used, total
+
+
+def shard_batch(mesh: Mesh, batch, axes: Sequence[str] = (AXIS_DP,)):
+    """Place a pytree of [B, ...] arrays with batch sharded over the given
+    mesh axes and everything else replicated."""
+    sharding = NamedSharding(mesh, P(tuple(axes) if len(axes) > 1 else axes[0]))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())

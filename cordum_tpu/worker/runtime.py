@@ -1,0 +1,307 @@
+"""TPU worker runtime: the in-tree worker that executes jobs as JAX/XLA
+computations.
+
+Recreates the reference worker runtime contract (``sdk/runtime/worker.go``):
+queue-subscribe pool subjects + the direct ``worker.<id>.jobs`` subject,
+``max_parallel_jobs`` semaphore, per-job cancel events fed by
+``sys.job.cancel``, periodic heartbeats with live load, result status
+inferred from handler outcome, ``progress()`` helper.
+
+TPU-native deltas (the north star's in-tree TPU worker):
+  * the worker owns its slice: one process per slice, handlers run JAX
+    computations in a thread-pool executor so the asyncio loop keeps
+    heartbeating while XLA blocks (SURVEY §7 "TPU worker process model")
+  * heartbeats carry slice telemetry (device kind, chip count, topology,
+    HBM use, duty-cycle estimate) for slice-aware scheduling
+  * cooperative cancel: handlers receive a :class:`JobContext` whose
+    ``cancelled`` event they may poll between jitted steps
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional
+
+from ..infra import logging as logx
+from ..infra.bus import Bus
+from ..infra.memstore import MemoryStore
+from ..protocol import subjects as subj
+from ..protocol.types import (
+    BusPacket,
+    Heartbeat,
+    JobCancel,
+    JobProgress,
+    JobRequest,
+    JobResult,
+    JobState,
+)
+
+HEARTBEAT_INTERVAL_S = 10.0
+
+
+class JobCancelled(Exception):
+    pass
+
+
+@dataclass
+class JobContext:
+    """Handed to job handlers: payload + progress/cancel plumbing."""
+
+    request: JobRequest
+    payload: Any
+    worker: "Worker"
+    cancelled: asyncio.Event = field(default_factory=asyncio.Event)
+    started_at: float = field(default_factory=time.monotonic)
+
+    def check_cancelled(self) -> None:
+        if self.cancelled.is_set():
+            raise JobCancelled(self.request.job_id)
+
+    async def progress(self, percent: float, message: str = "") -> None:
+        await self.worker.publish_progress(self.request.job_id, percent, message)
+
+
+Handler = Callable[[JobContext], Awaitable[Any]]
+
+
+class Worker:
+    def __init__(
+        self,
+        *,
+        bus: Bus,
+        store: MemoryStore,
+        worker_id: str,
+        pool: str = "default",
+        topics: Optional[list[str]] = None,
+        capabilities: Optional[list[str]] = None,
+        labels: Optional[dict[str, str]] = None,
+        max_parallel_jobs: int = 4,
+        heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
+        region: str = "",
+    ):
+        self.bus = bus
+        self.store = store
+        self.worker_id = worker_id
+        self.pool = pool
+        self.topics = topics or []
+        self.capabilities = capabilities or []
+        self.labels = labels or {}
+        self.max_parallel_jobs = max_parallel_jobs
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.region = region
+        self._handlers: dict[str, Handler] = {}
+        self._default_handler: Optional[Handler] = None
+        self._sem = asyncio.Semaphore(max_parallel_jobs)
+        self._active: dict[str, JobContext] = {}
+        self._subs: list = []
+        self._hb_task: Optional[asyncio.Task] = None
+        self._executor = ThreadPoolExecutor(max_workers=max_parallel_jobs, thread_name_prefix=f"{worker_id}-jax")
+        self._telemetry = _device_telemetry()
+        self._busy_since: Optional[float] = None
+        self._busy_accum = 0.0
+        self._window_start = time.monotonic()
+
+    # ------------------------------------------------------------------
+    def register(self, topic: str, handler: Handler) -> None:
+        """Register a handler for a topic (exact or used as fallback via
+        :meth:`register_default`)."""
+        self._handlers[topic] = handler
+
+    def register_default(self, handler: Handler) -> None:
+        self._default_handler = handler
+
+    async def run_in_executor(self, fn, *args):
+        """Run a blocking JAX computation off the event loop."""
+        return await asyncio.get_running_loop().run_in_executor(self._executor, fn, *args)
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        self._subs.append(
+            await self.bus.subscribe(subj.direct_subject(self.worker_id), self._on_job, queue=self.worker_id)
+        )
+        for topic in self.topics:
+            self._subs.append(await self.bus.subscribe(topic, self._on_job, queue=self.pool))
+        self._subs.append(await self.bus.subscribe(subj.CANCEL, self._on_cancel))
+        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+        await self.send_heartbeat()
+
+    async def stop(self) -> None:
+        if self._hb_task:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        for s in self._subs:
+            s.unsubscribe()
+        self._subs = []
+        self._executor.shutdown(wait=False)
+
+    # ------------------------------------------------------------------
+    async def _on_cancel(self, subject: str, pkt: BusPacket) -> None:
+        c = pkt.job_cancel
+        if c and c.job_id in self._active:
+            self._active[c.job_id].cancelled.set()
+
+    async def _on_job(self, subject: str, pkt: BusPacket) -> None:
+        req = pkt.job_request
+        if req is None or not req.job_id:
+            return
+        async with self._sem:
+            await self._run_job(req, trace_id=pkt.trace_id)
+
+    async def _run_job(self, req: JobRequest, *, trace_id: str = "") -> None:
+        if req.job_id in self._active:
+            return  # redelivery of an in-flight job
+        payload = None
+        if req.context_ptr:
+            payload = await self.store.get_pointer(req.context_ptr)
+        ctx = JobContext(request=req, payload=payload, worker=self)
+        self._active[req.job_id] = ctx
+        self._mark_busy()
+        t0 = time.monotonic()
+        status = JobState.SUCCEEDED.value
+        error_code = error_message = ""
+        result_ptr = ""
+        try:
+            handler = self._handlers.get(req.topic) or self._handlers.get(req.adapter_id) or self._default_handler
+            if handler is None:
+                raise RuntimeError(f"no handler for topic {req.topic!r}")
+            out = await handler(ctx)
+            if out is not None:
+                result_ptr = await self.store.put_result(req.job_id, out)
+        except JobCancelled:
+            status = JobState.CANCELLED.value
+            error_code, error_message = "CANCELLED", "cancelled"
+        except asyncio.CancelledError:
+            status = JobState.CANCELLED.value
+            error_code, error_message = "CANCELLED", "worker shutdown"
+        except Exception as e:  # noqa: BLE001 - handler failure → FAILED result
+            status = JobState.FAILED.value
+            error_code = type(e).__name__
+            error_message = str(e) or traceback.format_exc(limit=3)
+        finally:
+            self._active.pop(req.job_id, None)
+            self._mark_idle()
+        res = JobResult(
+            job_id=req.job_id,
+            status=status,
+            result_ptr=result_ptr,
+            worker_id=self.worker_id,
+            execution_ms=int((time.monotonic() - t0) * 1000),
+            error_code=error_code,
+            error_message=error_message,
+        )
+        await self.bus.publish(subj.RESULT, BusPacket.wrap(res, trace_id=trace_id, sender_id=self.worker_id))
+
+    # ------------------------------------------------------------------
+    async def publish_progress(self, job_id: str, percent: float, message: str = "") -> None:
+        await self.bus.publish(
+            subj.PROGRESS,
+            BusPacket.wrap(
+                JobProgress(job_id=job_id, percent=percent, message=message, worker_id=self.worker_id),
+                sender_id=self.worker_id,
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    def _mark_busy(self) -> None:
+        if self._busy_since is None and self._active:
+            self._busy_since = time.monotonic()
+
+    def _mark_idle(self) -> None:
+        if self._busy_since is not None and not self._active:
+            self._busy_accum += time.monotonic() - self._busy_since
+            self._busy_since = None
+
+    def _duty_cycle(self) -> float:
+        """Fraction of the heartbeat window the slice was executing jobs."""
+        now = time.monotonic()
+        busy = self._busy_accum
+        if self._busy_since is not None:
+            busy += now - self._busy_since
+        window = max(now - self._window_start, 1e-6)
+        self._busy_accum = 0.0
+        self._window_start = now
+        if self._busy_since is not None:
+            self._busy_since = now
+        return min(100.0, 100.0 * busy / window)
+
+    def build_heartbeat(self) -> Heartbeat:
+        tele = self._telemetry
+        hbm_used, hbm_total = tele["hbm"]()
+        return Heartbeat(
+            worker_id=self.worker_id,
+            region=self.region,
+            type="tpu" if tele["is_tpu"] else "cpu",
+            active_jobs=len(self._active),
+            max_parallel_jobs=self.max_parallel_jobs,
+            capabilities=list(self.capabilities),
+            pool=self.pool,
+            labels=dict(self.labels),
+            tpu_duty_cycle=self._duty_cycle(),
+            hbm_used_gb=hbm_used,
+            hbm_total_gb=hbm_total,
+            device_kind=tele["device_kind"],
+            chip_count=tele["chip_count"],
+            slice_topology=tele["topology"],
+            devices_healthy=tele["healthy"](),
+        )
+
+    async def send_heartbeat(self) -> None:
+        await self.bus.publish(
+            subj.HEARTBEAT, BusPacket.wrap(self.build_heartbeat(), sender_id=self.worker_id)
+        )
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_interval_s)
+            try:
+                await self.send_heartbeat()
+            except Exception:
+                logx.warn("heartbeat publish failed", worker_id=self.worker_id)
+
+
+def _device_telemetry() -> dict:
+    """Slice telemetry probes; degrades gracefully off-TPU and when JAX is
+    not yet initialized."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        from ..parallel.mesh import hbm_stats, slice_topology
+
+        kind = devs[0].device_kind if devs else ""
+        return {
+            "is_tpu": devs[0].platform == "tpu" if devs else False,
+            "device_kind": kind,
+            "chip_count": len(devs),
+            "topology": slice_topology(devs),
+            "hbm": lambda: hbm_stats(devs),
+            "healthy": lambda: _devices_alive(devs),
+        }
+    except Exception:
+        return {
+            "is_tpu": False,
+            "device_kind": "",
+            "chip_count": 0,
+            "topology": "",
+            "hbm": lambda: (0.0, 0.0),
+            "healthy": lambda: True,
+        }
+
+
+def _devices_alive(devs) -> bool:
+    """Liveness probe: a trivial computation must complete on each device."""
+    try:
+        import jax.numpy as jnp
+        import jax
+
+        for d in devs[:1]:  # probing one device per beat keeps it cheap
+            jax.block_until_ready(jax.device_put(jnp.zeros((1,)), d) + 1)
+        return True
+    except Exception:
+        return False

@@ -1,11 +1,23 @@
 """Test env: force JAX onto a virtual 8-device CPU mesh so sharding tests run
-without TPU hardware (must be set before jax import anywhere)."""
+without TPU hardware.
+
+The axon sitecustomize (PYTHONPATH=/root/.axon_site) registers the TPU-tunnel
+PJRT plugin in every interpreter and sets jax_platforms="axon,cpu" via
+jax.config — overriding the JAX_PLATFORMS env var.  The TPU grant is
+exclusive, so a test process that initializes the axon backend blocks forever
+behind any other claimant.  We must therefore (1) set the env vars, and
+(2) re-override jax.config AFTER the sitecustomize hook ran, before any
+backend initializes."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio
 import inspect
