@@ -1,0 +1,146 @@
+"""Headline benchmark: scheduled jobs/sec end-to-end through the control
+plane (BASELINE.json north star: ≥1,000 scheduled TPU jobs/sec on v5p-8).
+
+Drives the real pipeline — gateway-role submit → scheduler engine (safety
+check, strategy, state machine) → worker → result handling — over the
+in-process bus with the KV store, i.e. every control-plane code path a
+production deployment runs per job, minus network hops.  Also measures
+context-engine embeds/sec on the accelerator when one is available.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+N_JOBS = int(os.environ.get("BENCH_JOBS", "3000"))
+BASELINE_JOBS_PER_SEC = 1000.0  # BASELINE.json north-star target
+
+
+async def bench_scheduler() -> dict:
+    from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+    from cordum_tpu.controlplane.scheduler.engine import Engine
+    from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.infra.bus import LoopbackBus
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.jobstore import JobStore
+    from cordum_tpu.infra.kv import MemoryKV
+    from cordum_tpu.infra.memstore import MemoryStore
+    from cordum_tpu.infra.registry import WorkerRegistry
+    from cordum_tpu.protocol import subjects as subj
+    from cordum_tpu.protocol.types import BusPacket, Heartbeat, JobRequest, JobResult
+
+    kv = MemoryKV()
+    bus = LoopbackBus()
+    js = JobStore(kv)
+    ms = MemoryStore(kv)
+    kernel = SafetyKernel(
+        policy_doc={
+            "tenants": {"default": {"allow_topics": ["job.*", "job.>"]}},
+            "rules": [
+                {"id": "tpu", "match": {"topics": ["job.tpu.>"]}, "decision": "allow"},
+            ],
+        }
+    )
+    reg = WorkerRegistry()
+    pc = parse_pool_config({"topics": {"job.bench": "bench"}, "pools": {"bench": {"requires": []}}})
+    eng = Engine(
+        bus=bus, job_store=js, safety=SafetyClient(kernel.check),
+        strategy=LeastLoadedStrategy(reg, pc), registry=reg,
+    )
+    await eng.start()
+
+    done = asyncio.Event()
+    completed = 0
+
+    # minimal worker: replies immediately (we are measuring the control plane)
+    async def worker_handler(subject, pkt):
+        nonlocal completed
+        req = pkt.job_request
+        await bus.publish(
+            subj.RESULT,
+            BusPacket.wrap(
+                JobResult(job_id=req.job_id, status="SUCCEEDED", worker_id="bench-w"),
+                sender_id="bench-w",
+            ),
+        )
+
+    await bus.subscribe("worker.bench-w.jobs", worker_handler, queue="w")
+    for i in range(4):
+        reg.update(Heartbeat(worker_id="bench-w", pool="bench", max_parallel_jobs=1 << 30))
+
+    # count terminal results via the engine's completion metric
+    t0 = time.perf_counter()
+    for i in range(N_JOBS):
+        req = JobRequest(job_id=f"bench-{i}", topic="job.bench", tenant_id="default")
+        await bus.publish(subj.SUBMIT, BusPacket.wrap(req, sender_id="bench"))
+    await bus.drain()
+    # wait for all results to land
+    deadline = time.perf_counter() + 120
+    while time.perf_counter() < deadline:
+        await bus.drain()
+        n = eng.metrics.jobs_completed.value(status="SUCCEEDED")
+        if n >= N_JOBS:
+            break
+        await asyncio.sleep(0.01)
+    dt = time.perf_counter() - t0
+    n = eng.metrics.jobs_completed.value(status="SUCCEEDED")
+    p50 = eng.metrics.e2e_latency.quantile(0.5)
+    await eng.stop()
+    await bus.close()
+    return {
+        "jobs": int(n),
+        "seconds": dt,
+        "jobs_per_sec": n / dt if dt > 0 else 0.0,
+        "p50_e2e_ms": (p50 or 0.0) * 1000,
+    }
+
+
+def bench_embeds() -> dict:
+    """Context-engine embedding throughput on the available accelerator."""
+    try:
+        import jax
+
+        from cordum_tpu.models.embedder import Embedder, EmbedderConfig
+
+        cfg = EmbedderConfig()
+        e = Embedder(cfg, seed=0)
+        texts = [f"document {i}: control plane scheduling latency report" for i in range(256)]
+        e.embed(texts[:8])  # warm compile
+        t0 = time.perf_counter()
+        iters = 4
+        for _ in range(iters):
+            e.embed(texts)
+        dt = time.perf_counter() - t0
+        return {
+            "embeds_per_sec": iters * len(texts) / dt,
+            "embed_device": jax.devices()[0].device_kind,
+        }
+    except Exception as ex:  # accelerator unavailable → report scheduling only
+        return {"embeds_per_sec": 0.0, "embed_error": str(ex)[:120]}
+
+
+def main() -> None:
+    sched = asyncio.run(bench_scheduler())
+    emb = bench_embeds()
+    out = {
+        "metric": "scheduled_jobs_per_sec",
+        "value": round(sched["jobs_per_sec"], 1),
+        "unit": "jobs/s",
+        "vs_baseline": round(sched["jobs_per_sec"] / BASELINE_JOBS_PER_SEC, 3),
+        "p50_e2e_ms": round(sched["p50_e2e_ms"], 2),
+        "jobs": sched["jobs"],
+        "embeds_per_sec": round(emb.get("embeds_per_sec", 0.0), 1),
+    }
+    if "embed_device" in emb:
+        out["embed_device"] = emb["embed_device"]
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
