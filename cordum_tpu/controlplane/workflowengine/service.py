@@ -1,0 +1,137 @@
+"""Workflow-engine service: bus consumer + run reconciler.
+
+Recreates the reference service (``core/controlplane/workflowengine/``):
+subscribes ``sys.job.result`` in the ``cordum-workflow-engine`` queue group,
+takes a per-run lock before advancing the run (NAK-with-delay on
+contention — two consumers may converge on the same run), and a reconciler
+loop that (a) resumes delay steps and parked retries whose time has come,
+and (b) replays terminal job states from the JobStore into the engine for
+results the service missed (crash between worker publish and engine apply).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ...infra import logging as logx
+from ...infra.bus import Bus, RetryAfter
+from ...infra.jobstore import JobStore
+from ...protocol import subjects as subj
+from ...protocol.types import BusPacket, JobResult, JobState, TERMINAL_STATES
+from ...workflow import models as M
+from ...workflow.engine import Engine as WorkflowEngine, split_job_id
+
+
+class WorkflowEngineService:
+    def __init__(
+        self,
+        *,
+        engine: WorkflowEngine,
+        bus: Bus,
+        job_store: Optional[JobStore] = None,
+        instance_id: str = "wf-svc-0",
+        reconcile_interval_s: float = 5.0,
+    ):
+        self.engine = engine
+        self.bus = bus
+        self.job_store = job_store
+        self.instance_id = instance_id
+        self.reconcile_interval_s = reconcile_interval_s
+        self._subs: list = []
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+
+    async def start(self) -> None:
+        self._subs.append(
+            await self.bus.subscribe(
+                subj.RESULT, self._on_result, queue=subj.QUEUE_WORKFLOW_ENGINE
+            )
+        )
+        self._stop.clear()
+        self._task = asyncio.ensure_future(self._reconcile_loop())
+
+    async def stop(self) -> None:
+        for s in self._subs:
+            s.unsubscribe()
+        self._subs = []
+        self._stop.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    # ------------------------------------------------------------------
+    async def _on_result(self, subject: str, pkt: BusPacket) -> None:
+        res = pkt.job_result
+        if res is None or not res.job_id:
+            return
+        await self.handle_job_result(res)
+
+    async def handle_job_result(self, res: JobResult) -> None:
+        try:
+            run_id, _, _ = split_job_id(res.job_id)
+        except ValueError:
+            return  # not a workflow job
+        if not await self.engine.store.acquire_run_lock(run_id, self.instance_id):
+            raise RetryAfter(0.05, f"run {run_id} locked")
+        try:
+            await self.engine.handle_job_result(res)
+        finally:
+            await self.engine.store.release_run_lock(run_id, self.instance_id)
+
+    # ------------------------------------------------------------------
+    async def _reconcile_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.reconcile_once()
+            except Exception:
+                logx.error("workflow reconciler pass failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.reconcile_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def reconcile_once(self) -> int:
+        """Resume due waits and replay missed terminal job states."""
+        progressed = 0
+        for status in (M.PENDING, M.RUNNING, M.WAITING):
+            for run_id in await self.engine.store.list_run_ids_by_status(status):
+                if not await self.engine.store.acquire_run_lock(run_id, self.instance_id):
+                    continue
+                try:
+                    if await self.engine.resume_due(run_id):
+                        progressed += 1
+                    if self.job_store is not None:
+                        progressed += await self._replay_terminal_jobs(run_id)
+                finally:
+                    await self.engine.store.release_run_lock(run_id, self.instance_id)
+        return progressed
+
+    async def _replay_terminal_jobs(self, run_id: str) -> int:
+        """If the JobStore saw a terminal state for a step's job but the run
+        still shows it RUNNING, synthesize the JobResult and apply it."""
+        run = await self.engine.store.get_run(run_id)
+        if run is None:
+            return 0
+        n = 0
+        for sr in run.steps.values():
+            for t in [sr, *sr.children.values()]:
+                if t.status != M.RUNNING or not t.job_id:
+                    continue
+                meta = await self.job_store.get_meta(t.job_id)
+                state = meta.get("state", "")
+                if state and state in (s.value for s in TERMINAL_STATES):
+                    res = JobResult(
+                        job_id=t.job_id,
+                        status=state,
+                        result_ptr=meta.get("result_ptr", ""),
+                        worker_id=meta.get("worker_id", ""),
+                        error_code=meta.get("error_code", ""),
+                        error_message=meta.get("error_message", ""),
+                    )
+                    await self.engine.handle_job_result(res)
+                    n += 1
+        return n

@@ -1,0 +1,516 @@
+"""Workflow engine tests: expression eval, templates, store, the step state
+machine (DAG, conditions, delay/notify/approval, fan-out with max_parallel,
+retries, rerun), and service integration with the scheduler+worker."""
+import asyncio
+import json
+
+import pytest
+
+from cordum_tpu.infra.bus import LoopbackBus
+from cordum_tpu.infra.jobstore import JobStore
+from cordum_tpu.infra.kv import MemoryKV
+from cordum_tpu.infra.memstore import MemoryStore
+from cordum_tpu.infra.schemareg import SchemaRegistry
+from cordum_tpu.protocol import subjects as subj
+from cordum_tpu.protocol.types import BusPacket, JobResult
+from cordum_tpu.workflow import models as M
+from cordum_tpu.workflow.engine import Engine, make_job_id, split_job_id
+from cordum_tpu.workflow.eval import evaluate, expand_templates, resolve_path, set_path, truthy
+from cordum_tpu.workflow.models import Workflow
+from cordum_tpu.workflow.store import WorkflowStore
+
+
+# ---------------------------------------------------------------- eval
+
+def test_eval_literals_and_paths():
+    scope = {"input": {"n": 3, "name": "x"}, "steps": {"a": {"out": [1, 2]}}}
+    assert evaluate("input.n", scope) == 3
+    assert evaluate("steps.a.out.1", scope) == 2
+    assert evaluate("input.missing", scope) is None
+    assert evaluate("'hello'", scope) == "hello"
+    assert evaluate("42", scope) == 42
+    assert evaluate("true", scope) is True
+
+
+def test_eval_comparisons_and_negation():
+    scope = {"input": {"n": 3, "s": "ok"}}
+    assert evaluate("input.n == 3", scope) is True
+    assert evaluate("input.n != 3", scope) is False
+    assert evaluate("input.n > 2", scope) is True
+    assert evaluate("input.n <= 2", scope) is False
+    assert evaluate("input.s == 'ok'", scope) is True
+    assert evaluate("!input.missing", scope) is True
+    assert evaluate("!input.n", scope) is False
+
+
+def test_eval_functions():
+    scope = {"steps": {"a": {"items": [5, 6, 7]}}}
+    assert evaluate("length(steps.a.items)", scope) == 3
+    assert evaluate("first(steps.a.items)", scope) == 5
+    assert evaluate("length(steps.a.items) == 3", scope) is True
+    assert evaluate("length(steps.missing)", scope) == 0
+
+
+def test_truthy():
+    assert truthy(1) and truthy("x") and truthy([0]) and truthy({"a": 1})
+    assert not truthy(0) and not truthy("") and not truthy([]) and not truthy(None)
+    assert not truthy("false")
+
+
+def test_templates():
+    scope = {"input": {"name": "world", "n": 2}, "steps": {"a": {"v": [1, 2]}}}
+    assert expand_templates("${input.name}", scope) == "world"
+    assert expand_templates("${steps.a.v}", scope) == [1, 2]  # type-preserving
+    assert expand_templates("hello ${input.name}!", scope) == "hello world!"
+    assert expand_templates({"x": "${input.n}", "y": ["${input.name}"]}, scope) == {
+        "x": 2, "y": ["world"]
+    }
+    assert expand_templates("a=${steps.a.v}", scope) == "a=[1, 2]"
+
+
+def test_set_path():
+    d = {}
+    set_path(d, "a.b.c", 5)
+    assert d == {"a": {"b": {"c": 5}}}
+
+
+def test_job_id_roundtrip():
+    jid = make_job_id("run-1", "step#3", 2)
+    assert split_job_id(jid) == ("run-1", "step#3", 2)
+    with pytest.raises(ValueError):
+        split_job_id("plain-job-id")
+
+
+# ---------------------------------------------------------------- harness
+
+def wf_doc(steps, **kw):
+    return {"id": kw.get("id", "wf1"), "name": "test", "steps": steps, **kw}
+
+
+class Harness:
+    def __init__(self, kv=None):
+        self.kv = kv or MemoryKV()
+        self.bus = LoopbackBus(sync=True)
+        self.store = WorkflowStore(self.kv)
+        self.mem = MemoryStore(self.kv)
+        self.schemas = SchemaRegistry(self.kv)
+        self.engine = Engine(store=self.store, bus=self.bus, mem=self.mem, schemas=self.schemas)
+        self.dispatched: list = []
+
+    async def setup(self, doc):
+        wf = Workflow.from_dict(doc)
+        assert wf.validate() == []
+        await self.store.put_workflow(wf)
+
+        async def capture(subject, pkt):
+            if pkt.job_request:
+                self.dispatched.append(pkt.job_request)
+
+        await self.bus.subscribe(subj.SUBMIT, capture)
+        return wf
+
+    async def succeed(self, job_id, output=None):
+        ptr = ""
+        if output is not None:
+            ptr = await self.mem.put_result(job_id, output)
+        await self.engine.handle_job_result(
+            JobResult(job_id=job_id, status="SUCCEEDED", result_ptr=ptr, worker_id="w")
+        )
+
+    async def fail(self, job_id, msg="boom"):
+        await self.engine.handle_job_result(
+            JobResult(job_id=job_id, status="FAILED", error_message=msg, worker_id="w")
+        )
+
+
+# ---------------------------------------------------------------- engine
+
+async def test_linear_dag_dataflow():
+    h = Harness()
+    await h.setup(wf_doc({
+        "a": {"topic": "job.t", "input": {"v": "${input.x}"}},
+        "b": {"topic": "job.t", "depends_on": ["a"], "input": {"prev": "${steps.a.doubled}"}},
+    }))
+    run = await h.engine.start_run("wf1", {"x": 21})
+    assert run.status == M.RUNNING
+    assert len(h.dispatched) == 1
+    ctx = await h.mem.get_pointer(h.dispatched[0].context_ptr)
+    assert ctx == {"v": 21}
+    await h.succeed(h.dispatched[0].job_id, {"doubled": 42})
+    assert len(h.dispatched) == 2
+    ctx_b = await h.mem.get_pointer(h.dispatched[1].context_ptr)
+    assert ctx_b == {"prev": 42}
+    await h.succeed(h.dispatched[1].job_id, {"ok": True})
+    run = await h.store.get_run(run.run_id)
+    assert run.status == M.SUCCEEDED
+    assert run.context["steps"]["b"] == {"ok": True}
+
+
+async def test_parallel_independent_steps():
+    h = Harness()
+    await h.setup(wf_doc({
+        "a": {"topic": "job.t"},
+        "b": {"topic": "job.t"},
+        "c": {"topic": "job.t", "depends_on": ["a", "b"]},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    assert len(h.dispatched) == 2  # a and b dispatch in the same wave
+    await h.succeed(h.dispatched[0].job_id, {})
+    assert len(h.dispatched) == 2  # c still blocked on b
+    await h.succeed(h.dispatched[1].job_id, {})
+    assert len(h.dispatched) == 3
+
+
+async def test_condition_gate_skips_and_dependents_run():
+    h = Harness()
+    await h.setup(wf_doc({
+        "a": {"topic": "job.t", "condition": "input.enabled"},
+        "b": {"topic": "job.t", "depends_on": ["a"]},
+    }))
+    run = await h.engine.start_run("wf1", {"enabled": False})
+    run = await h.store.get_run(run.run_id)
+    assert run.steps["a"].status == M.SKIPPED
+    # SKIPPED counts as satisfied → b dispatched
+    assert len(h.dispatched) == 1 and h.dispatched[0].job_id.split(":")[1].startswith("b")
+
+
+async def test_condition_step_records_value():
+    h = Harness()
+    await h.setup(wf_doc({
+        "check": {"type": "condition", "condition": "input.n > 2"},
+        "then": {"topic": "job.t", "depends_on": ["check"], "condition": "steps.check.value"},
+    }))
+    run = await h.engine.start_run("wf1", {"n": 5})
+    run = await h.store.get_run(run.run_id)
+    assert run.context["steps"]["check"] == {"value": True}
+    assert len(h.dispatched) == 1
+    run2 = await h.engine.start_run("wf1", {"n": 1})
+    run2 = await h.store.get_run(run2.run_id)
+    assert run2.steps["then"].status == M.SKIPPED
+    assert run2.status == M.SUCCEEDED
+
+
+async def test_notify_step_emits_alert():
+    h = Harness()
+    alerts = []
+
+    async def tap(subject, pkt):
+        alerts.append(pkt.system_alert)
+
+    await h.bus.subscribe(subj.WORKFLOW_EVENT, tap)
+    await h.setup(wf_doc({
+        "n": {"type": "notify", "notify_message": "run for ${input.who}", "notify_severity": "warning"},
+    }))
+    run = await h.engine.start_run("wf1", {"who": "ops"})
+    assert alerts and alerts[0].message == "run for ops"
+    assert alerts[0].severity == "warning"
+    run = await h.store.get_run(run.run_id)
+    assert run.status == M.SUCCEEDED
+
+
+async def test_delay_step_parks_and_resumes():
+    h = Harness()
+    await h.setup(wf_doc({
+        "wait": {"type": "delay", "delay_sec": 0.05},
+        "after": {"topic": "job.t", "depends_on": ["wait"]},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    run = await h.store.get_run(run.run_id)
+    assert run.steps["wait"].status == M.WAITING
+    assert run.status == M.WAITING
+    assert not h.dispatched
+    await asyncio.sleep(0.06)
+    assert await h.engine.resume_due(run.run_id)
+    run = await h.store.get_run(run.run_id)
+    assert run.steps["wait"].status == M.SUCCEEDED
+    assert len(h.dispatched) == 1
+
+
+async def test_approval_step_pauses_run():
+    h = Harness()
+    await h.setup(wf_doc({
+        "gate": {"type": "approval"},
+        "deploy": {"topic": "job.t", "depends_on": ["gate"]},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    run = await h.store.get_run(run.run_id)
+    assert run.status == M.WAITING_APPROVAL
+    assert not h.dispatched
+    run = await h.engine.approve_step(run.run_id, "gate", approve=True, approved_by="admin")
+    assert len(h.dispatched) == 1
+    await h.succeed(h.dispatched[0].job_id, {})
+    run = await h.store.get_run(run.run_id)
+    assert run.status == M.SUCCEEDED
+    tl = await h.store.timeline(run.run_id)
+    assert any(e["event"] == "approved" for e in tl)
+
+
+async def test_approval_rejection_fails_run():
+    h = Harness()
+    await h.setup(wf_doc({"gate": {"type": "approval"}, "x": {"topic": "job.t", "depends_on": ["gate"]}}))
+    run = await h.engine.start_run("wf1", {})
+    run = await h.engine.approve_step(run.run_id, "gate", approve=False, approved_by="admin")
+    assert run.status == M.FAILED
+    assert run.steps["x"].status == M.SKIPPED
+
+
+async def test_for_each_fanout_with_max_parallel():
+    h = Harness()
+    await h.setup(wf_doc({
+        "fan": {"topic": "job.t", "for_each": "input.items", "max_parallel": 2,
+                "input": {"val": "${item}", "idx": "${foreach_index}"}},
+    }))
+    run = await h.engine.start_run("wf1", {"items": ["a", "b", "c", "d", "e"]})
+    assert len(h.dispatched) == 2  # throttled
+    ctx0 = await h.mem.get_pointer(h.dispatched[0].context_ptr)
+    assert ctx0["item"] == "a" and ctx0["input"] == {"val": "a", "idx": 0}
+    # completing one child admits the next
+    await h.succeed(h.dispatched[0].job_id, {"r": "a"})
+    assert len(h.dispatched) == 3
+    for req in list(h.dispatched[1:]):
+        await h.succeed(req.job_id, {"r": "x"})
+    assert len(h.dispatched) == 5
+    for req in list(h.dispatched[3:]):
+        await h.succeed(req.job_id, {"r": "y"})
+    run = await h.store.get_run(run.run_id)
+    assert run.status == M.SUCCEEDED
+    agg = run.context["steps"]["fan"]
+    assert agg["count"] == 5
+    assert agg["children"][0] == {"r": "a"}
+
+
+async def test_for_each_empty_list_succeeds():
+    h = Harness()
+    await h.setup(wf_doc({"fan": {"topic": "job.t", "for_each": "input.items"}}))
+    run = await h.engine.start_run("wf1", {"items": []})
+    run = await h.store.get_run(run.run_id)
+    assert run.status == M.SUCCEEDED and not h.dispatched
+
+
+async def test_for_each_non_list_fails():
+    h = Harness()
+    await h.setup(wf_doc({"fan": {"topic": "job.t", "for_each": "input.items"}}))
+    run = await h.engine.start_run("wf1", {"items": 42})
+    run = await h.store.get_run(run.run_id)
+    assert run.status == M.FAILED
+
+
+async def test_for_each_child_failure_fails_parent_and_run():
+    h = Harness()
+    await h.setup(wf_doc({"fan": {"topic": "job.t", "for_each": "input.items"}}))
+    run = await h.engine.start_run("wf1", {"items": [1, 2]})
+    await h.succeed(h.dispatched[0].job_id, {})
+    await h.fail(h.dispatched[1].job_id, "child exploded")
+    run = await h.store.get_run(run.run_id)
+    assert run.steps["fan"].status == M.FAILED
+    assert run.status == M.FAILED
+
+
+async def test_retry_with_backoff_then_success():
+    h = Harness()
+    await h.setup(wf_doc({
+        "r": {"topic": "job.t", "retry": {"max_retries": 2, "backoff_sec": 0.02, "multiplier": 1.0}},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    jid1 = h.dispatched[0].job_id
+    assert jid1.endswith("@1")
+    await h.fail(jid1)
+    run = await h.store.get_run(run.run_id)
+    assert run.steps["r"].status == M.WAITING
+    assert run.status == M.WAITING
+    assert not await h.engine.resume_due(run.run_id)  # backoff not elapsed
+    await asyncio.sleep(0.03)
+    assert await h.engine.resume_due(run.run_id)
+    assert len(h.dispatched) == 2 and h.dispatched[1].job_id.endswith("@2")
+    await h.succeed(h.dispatched[1].job_id, {"ok": 1})
+    run = await h.store.get_run(run.run_id)
+    assert run.status == M.SUCCEEDED
+
+
+async def test_retry_exhaustion_fails():
+    h = Harness()
+    await h.setup(wf_doc({
+        "r": {"topic": "job.t", "retry": {"max_retries": 1, "backoff_sec": 0.01}},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    await h.fail(h.dispatched[0].job_id)
+    await asyncio.sleep(0.02)
+    await h.engine.resume_due(run.run_id)
+    await h.fail(h.dispatched[1].job_id)
+    run = await h.store.get_run(run.run_id)
+    assert run.steps["r"].status == M.FAILED and run.status == M.FAILED
+
+
+async def test_stale_attempt_and_duplicate_results_ignored():
+    h = Harness()
+    await h.setup(wf_doc({
+        "r": {"topic": "job.t", "retry": {"max_retries": 3, "backoff_sec": 0.0}},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    jid1 = h.dispatched[0].job_id
+    await h.fail(jid1)
+    await h.engine.resume_due(run.run_id)
+    jid2 = h.dispatched[1].job_id
+    # stale result for attempt 1 arrives late: ignored
+    await h.succeed(jid1, {"stale": True})
+    run2 = await h.store.get_run(run.run_id)
+    assert run2.steps["r"].status == M.RUNNING
+    await h.succeed(jid2, {"fresh": True})
+    await h.succeed(jid2, {"dup": True})  # duplicate redelivery: no-op
+    run3 = await h.store.get_run(run.run_id)
+    assert run3.context["steps"]["r"] == {"fresh": True}
+
+
+async def test_on_error_continue():
+    h = Harness()
+    await h.setup(wf_doc({
+        "flaky": {"topic": "job.t", "on_error": "continue"},
+        "next": {"topic": "job.t", "depends_on": ["flaky"]},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    await h.fail(h.dispatched[0].job_id)
+    run = await h.store.get_run(run.run_id)
+    assert run.steps["flaky"].status == M.FAILED
+    # continue-on-error: the dependent still runs and the run can succeed
+    assert len(h.dispatched) == 2
+    await h.succeed(h.dispatched[1].job_id, {"ok": 1})
+    run = await h.store.get_run(run.run_id)
+    assert run.steps["next"].status == M.SUCCEEDED
+    assert run.status == M.SUCCEEDED
+
+
+async def test_output_path_and_schema_validation():
+    h = Harness()
+    await h.schemas.put("out1", {"type": "object", "required": ["score"]})
+    await h.setup(wf_doc({
+        "s": {"topic": "job.t", "output_schema_id": "out1", "output_path": "results.final"},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    await h.succeed(h.dispatched[0].job_id, {"score": 9})
+    run = await h.store.get_run(run.run_id)
+    assert run.context["results"]["final"] == {"score": 9}
+    # invalid output fails the step
+    run2 = await h.engine.start_run("wf1", {})
+    await h.succeed(h.dispatched[1].job_id, {"wrong": 1})
+    run2 = await h.store.get_run(run2.run_id)
+    assert run2.status == M.FAILED
+
+
+async def test_input_schema_validation_blocks_run():
+    h = Harness()
+    await h.schemas.put("in1", {"type": "object", "required": ["x"]})
+    await h.setup(wf_doc({"s": {"topic": "job.t"}}, input_schema_id="in1"))
+    from cordum_tpu.workflow.engine import WorkflowError
+
+    with pytest.raises(WorkflowError):
+        await h.engine.start_run("wf1", {"y": 1})
+
+
+async def test_run_idempotency_key():
+    h = Harness()
+    await h.setup(wf_doc({"s": {"topic": "job.t"}}))
+    r1 = await h.engine.start_run("wf1", {}, idempotency_key="k1")
+    r2 = await h.engine.start_run("wf1", {}, idempotency_key="k1")
+    assert r1.run_id == r2.run_id
+    assert len(h.dispatched) == 1
+
+
+async def test_cancel_run_broadcasts_jobcancel():
+    h = Harness()
+    cancels = []
+
+    async def tap(subject, pkt):
+        cancels.append(pkt.job_cancel.job_id)
+
+    await h.bus.subscribe(subj.CANCEL, tap)
+    await h.setup(wf_doc({"s": {"topic": "job.t"}, "t": {"topic": "job.t"}}))
+    run = await h.engine.start_run("wf1", {})
+    run = await h.engine.cancel_run(run.run_id, reason="user")
+    assert run.status == M.CANCELLED
+    assert len(cancels) == 2
+
+
+async def test_rerun_from_resets_dependent_closure():
+    h = Harness()
+    await h.setup(wf_doc({
+        "a": {"topic": "job.t"},
+        "b": {"topic": "job.t", "depends_on": ["a"]},
+        "c": {"topic": "job.t", "depends_on": ["b"]},
+        "other": {"topic": "job.t"},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    # complete steps as they dispatch until the run succeeds
+    applied = 0
+    while (await h.store.get_run(run.run_id)).status != M.SUCCEEDED:
+        for req in h.dispatched[applied:]:
+            applied += 1
+            await h.succeed(req.job_id, {"from": req.job_id.split(":")[1].split("@")[0]})
+    n_before = len(h.dispatched)
+    rerun = await h.engine.rerun_from(run.run_id, "b")
+    # only b redispATCHED (a and other preserved), c reset pending on b
+    new = h.dispatched[n_before:]
+    assert len(new) == 1 and new[0].job_id.startswith(rerun.run_id) and ":b@" in new[0].job_id
+    assert rerun.steps["a"].status == M.SUCCEEDED
+    assert rerun.steps["other"].status == M.SUCCEEDED
+    await h.succeed(new[0].job_id, {})
+    new2 = h.dispatched[n_before + 1:]
+    assert len(new2) == 1 and ":c@" in new2[0].job_id
+
+
+async def test_dry_run_labels_jobs():
+    h = Harness()
+    await h.setup(wf_doc({"s": {"topic": "job.t"}}))
+    await h.engine.start_run("wf1", {}, dry_run=True)
+    assert h.dispatched[0].labels.get("cordum.dry_run") == "true"
+
+
+async def test_step_meta_flows_to_job_metadata():
+    h = Harness()
+    await h.setup(wf_doc({
+        "s": {"topic": "job.tpu.infer", "meta": {"capability": "tpu", "requires": ["tpu", "chips:4"]},
+              "route_labels": {"preferred_pool": "tpu"}},
+    }))
+    await h.engine.start_run("wf1", {})
+    req = h.dispatched[0]
+    assert req.metadata.capability == "tpu"
+    assert req.metadata.requires == ["tpu", "chips:4"]
+    assert req.labels["preferred_pool"] == "tpu"
+
+
+async def test_workflow_validate():
+    wf = Workflow.from_dict(wf_doc({"a": {"topic": "t", "depends_on": ["zzz"]}}))
+    assert any("unknown dependency" in e for e in wf.validate())
+    cyc = Workflow.from_dict(wf_doc({
+        "a": {"topic": "t", "depends_on": ["b"]},
+        "b": {"topic": "t", "depends_on": ["a"]},
+    }))
+    assert any("cycle" in e for e in cyc.validate())
+    nob = Workflow.from_dict(wf_doc({"a": {"type": "worker"}}))
+    assert any("needs a topic" in e for e in nob.validate())
+
+
+# ---------------------------------------------------------------- store
+
+async def test_workflow_store_roundtrip(kv):
+    store = WorkflowStore(kv)
+    wf = Workflow.from_dict(wf_doc({"s": {"topic": "job.t"}}, org_id="acme"))
+    await store.put_workflow(wf)
+    back = await store.get_workflow("wf1")
+    assert back.steps["s"].topic == "job.t"
+    assert "wf1" in await store.list_workflows()
+    assert await store.delete_workflow("wf1")
+
+
+async def test_run_status_indexes(kv):
+    from cordum_tpu.workflow.models import WorkflowRun
+
+    store = WorkflowStore(kv)
+    run = WorkflowRun(run_id="r1", workflow_id="wf1", org_id="o", status=M.RUNNING, created_at_us=1)
+    await store.put_run(run)
+    assert "r1" in await store.list_run_ids_by_status(M.RUNNING)
+    assert await store.count_active_runs("o") == 1
+    run.status = M.SUCCEEDED
+    await store.put_run(run)
+    assert "r1" not in await store.list_run_ids_by_status(M.RUNNING)
+    assert "r1" in await store.list_run_ids_by_status(M.SUCCEEDED)
+    assert await store.count_active_runs("o") == 0
