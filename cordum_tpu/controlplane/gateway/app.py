@@ -1,0 +1,819 @@
+"""API gateway: the HTTP/WS surface of the control plane.
+
+Recreates the reference gateway's API (``core/controlplane/gateway/
+gateway.go``, 4373 LoC — route table :701-805) on aiohttp:
+
+  jobs            POST/GET/list/cancel/remediate, trace reader
+  approvals       list / approve / reject with job-hash + snapshot binding
+  workflows       CRUD + run start (Idempotency-Key header, max-concurrent
+                  guard) / cancel / rerun / step-approve / timeline
+  DLQ             list / get / delete / retry-with-new-job-id
+  policy          evaluate / simulate / explain / snapshots
+  config          scoped get/set + effective view
+  schemas         CRUD
+  locks           list / acquire / release
+  artifacts       put / get
+  memory          pointer reader (``?ptr=kv://...``)
+  workers         live registry snapshot
+  status/healthz  bus+kv health;  /metrics Prometheus text
+  /api/v1/stream  WebSocket event stream (bus tap broadcast)
+
+Bus taps (reference gateway.go:531-650): heartbeats → worker map, DLQ tap →
+DLQStore, ``sys.job.>`` + workflow events → WS broadcast.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Optional
+
+from aiohttp import WSMsgType, web
+
+from ...infra import logging as logx
+from ...infra.artifacts import ArtifactStore
+from ...infra.bus import Bus
+from ...infra.configsvc import ConfigService
+from ...infra.dlq import DLQEntry, DLQStore
+from ...infra.jobstore import ApprovalRecord, JobStore
+from ...infra.kv import KV
+from ...infra.locks import LockStore
+from ...infra.memstore import MemoryStore
+from ...infra.metrics import Metrics
+from ...infra.registry import WorkerRegistry
+from ...infra.schemareg import SchemaError, SchemaRegistry
+from ...infra.secrets import contains_secret_refs
+from ...protocol import subjects as subj
+from ...protocol.jobhash import job_hash
+from ...protocol.types import (
+    Budget,
+    BusPacket,
+    ContextHints,
+    JobCancel,
+    JobMetadata,
+    JobRequest,
+    JobState,
+    LABEL_APPROVAL_GRANTED,
+    LABEL_BUS_MSG_ID,
+    LABEL_SECRETS_PRESENT,
+    PolicyCheckRequest,
+    TERMINAL_STATES,
+)
+from ...utils.ids import new_id, now_us
+from ...workflow.engine import Engine as WorkflowEngine, WorkflowError
+from ...workflow.models import Workflow
+from ...workflow.store import WorkflowStore
+from ..safetykernel.kernel import SafetyKernel
+from .auth import AuthProvider, BasicAuthProvider, Principal, TokenBucket
+
+MAX_BODY_BYTES = 2 * 1024 * 1024  # 2 MiB submit cap (reference gateway.go:1757)
+
+
+def _err(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+class Gateway:
+    def __init__(
+        self,
+        *,
+        kv: KV,
+        bus: Bus,
+        job_store: JobStore,
+        mem: MemoryStore,
+        kernel: SafetyKernel,
+        wf_store: WorkflowStore,
+        wf_engine: WorkflowEngine,
+        schemas: Optional[SchemaRegistry] = None,
+        configsvc: Optional[ConfigService] = None,
+        registry: Optional[WorkerRegistry] = None,
+        auth: Optional[AuthProvider] = None,
+        metrics: Optional[Metrics] = None,
+        rate_rps: float = 0.0,
+        max_concurrent_runs: int = 0,
+        ws_allowed_origins: Optional[list[str]] = None,
+        instance_id: str = "gateway-0",
+    ):
+        self.kv = kv
+        self.bus = bus
+        self.job_store = job_store
+        self.mem = mem
+        self.kernel = kernel
+        self.wf_store = wf_store
+        self.wf_engine = wf_engine
+        self.schemas = schemas or SchemaRegistry(kv)
+        self.configsvc = configsvc
+        self.registry = registry
+        self.dlq = DLQStore(kv)
+        self.locks = LockStore(kv)
+        self.artifacts = ArtifactStore(kv)
+        self.auth = auth or BasicAuthProvider()
+        self.metrics = metrics or Metrics()
+        self.rate = TokenBucket(rate_rps)
+        self.max_concurrent_runs = max_concurrent_runs
+        self.ws_allowed_origins = ws_allowed_origins
+        self.instance_id = instance_id
+        self._ws_clients: set[web.WebSocketResponse] = set()
+        self._subs: list = []
+        self._runner: Optional[web.AppRunner] = None
+        self.app = self._build_app()
+
+    # ------------------------------------------------------------------
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=MAX_BODY_BYTES, middlewares=[self._middleware])
+        r = app.router
+        v1 = "/api/v1"
+        r.add_post(f"{v1}/jobs", self.submit_job)
+        r.add_get(f"{v1}/jobs", self.list_jobs)
+        r.add_get(f"{v1}/jobs/{{job_id}}", self.get_job)
+        r.add_post(f"{v1}/jobs/{{job_id}}/cancel", self.cancel_job)
+        r.add_post(f"{v1}/jobs/{{job_id}}/remediate", self.remediate_job)
+        r.add_get(f"{v1}/approvals", self.list_approvals)
+        r.add_post(f"{v1}/approvals/{{job_id}}/approve", self.approve_job)
+        r.add_post(f"{v1}/approvals/{{job_id}}/reject", self.reject_job)
+        r.add_post(f"{v1}/workflows", self.put_workflow)
+        r.add_get(f"{v1}/workflows", self.list_workflows)
+        r.add_get(f"{v1}/workflows/{{wf_id}}", self.get_workflow)
+        r.add_delete(f"{v1}/workflows/{{wf_id}}", self.delete_workflow)
+        r.add_post(f"{v1}/workflows/{{wf_id}}/runs", self.start_run)
+        r.add_get(f"{v1}/runs", self.list_runs)
+        r.add_get(f"{v1}/runs/{{run_id}}", self.get_run)
+        r.add_post(f"{v1}/runs/{{run_id}}/cancel", self.cancel_run)
+        r.add_post(f"{v1}/runs/{{run_id}}/rerun", self.rerun)
+        r.add_post(f"{v1}/runs/{{run_id}}/steps/{{step_id}}/approve", self.approve_step)
+        r.add_get(f"{v1}/runs/{{run_id}}/timeline", self.run_timeline)
+        r.add_get(f"{v1}/dlq", self.list_dlq)
+        r.add_delete(f"{v1}/dlq/{{job_id}}", self.delete_dlq)
+        r.add_post(f"{v1}/dlq/{{job_id}}/retry", self.retry_dlq)
+        r.add_post(f"{v1}/policy/evaluate", self.policy_evaluate)
+        r.add_post(f"{v1}/policy/simulate", self.policy_simulate)
+        r.add_post(f"{v1}/policy/explain", self.policy_explain)
+        r.add_get(f"{v1}/policy/snapshots", self.policy_snapshots)
+        r.add_get(f"{v1}/config/effective", self.config_effective)
+        r.add_get(f"{v1}/config/{{scope}}/{{doc_id:.+}}", self.config_get)
+        r.add_put(f"{v1}/config/{{scope}}/{{doc_id:.+}}", self.config_set)
+        r.add_get(f"{v1}/schemas", self.list_schemas)
+        r.add_get(f"{v1}/schemas/{{schema_id}}", self.get_schema)
+        r.add_put(f"{v1}/schemas/{{schema_id}}", self.put_schema)
+        r.add_delete(f"{v1}/schemas/{{schema_id}}", self.delete_schema)
+        r.add_get(f"{v1}/locks", self.list_locks)
+        r.add_post(f"{v1}/locks/{{resource}}/acquire", self.acquire_lock)
+        r.add_post(f"{v1}/locks/{{resource}}/release", self.release_lock)
+        r.add_post(f"{v1}/artifacts", self.put_artifact)
+        r.add_get(f"{v1}/artifacts/{{artifact_id}}", self.get_artifact)
+        r.add_get(f"{v1}/memory", self.read_pointer)
+        r.add_get(f"{v1}/traces/{{trace_id}}", self.get_trace)
+        r.add_get(f"{v1}/workers", self.get_workers)
+        r.add_get(f"{v1}/status", self.get_status)
+        r.add_get(f"{v1}/stream", self.ws_stream)
+        r.add_get("/healthz", self.healthz)
+        r.add_get("/metrics", self.get_metrics)
+        return app
+
+    @web.middleware
+    async def _middleware(self, request: web.Request, handler):
+        t0 = time.perf_counter()
+        if not self.rate.allow(request.headers.get("X-Api-Key", request.remote or "")):
+            return _err(429, "rate limited")
+        if request.path in ("/healthz", "/metrics"):
+            request["principal"] = Principal()
+            return await handler(request)
+        principal = self.auth.authenticate(request.headers)
+        if principal is None:
+            return _err(401, "invalid API key")
+        request["principal"] = principal
+        try:
+            resp = await handler(request)
+        except web.HTTPException:
+            raise
+        except WorkflowError as e:
+            resp = _err(400, str(e))
+        except SchemaError as e:
+            resp = _err(400, str(e))
+        except Exception as e:  # noqa: BLE001
+            logx.error("gateway handler error", path=request.path, err=str(e))
+            resp = _err(500, "internal error")
+        self.metrics.http_requests.inc(method=request.method, path=request.match_info.route.resource.canonical if request.match_info.route.resource else request.path, status=str(resp.status))
+        self.metrics.http_latency.observe(time.perf_counter() - t0)
+        return resp
+
+    # ------------------------------------------------------------------
+    # lifecycle + bus taps
+    # ------------------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 8081) -> None:
+        self._subs.append(await self.bus.subscribe(subj.DLQ, self._tap_dlq))
+        self._subs.append(await self.bus.subscribe("sys.job.>", self._tap_events))
+        self._subs.append(await self.bus.subscribe(subj.WORKFLOW_EVENT, self._tap_events))
+        if self.registry is not None:
+            self._subs.append(await self.bus.subscribe(subj.HEARTBEAT, self._tap_heartbeat))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        logx.info("gateway listening", host=host, port=port)
+
+    async def stop(self) -> None:
+        for s in self._subs:
+            s.unsubscribe()
+        self._subs = []
+        for ws in list(self._ws_clients):
+            await ws.close()
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _tap_heartbeat(self, subject: str, pkt: BusPacket) -> None:
+        if pkt.heartbeat and self.registry is not None:
+            self.registry.update(pkt.heartbeat)
+
+    async def _tap_dlq(self, subject: str, pkt: BusPacket) -> None:
+        res = pkt.job_result
+        if res is None:
+            return
+        await self.dlq.add(
+            DLQEntry(
+                job_id=res.job_id,
+                topic=res.labels.get("topic", ""),
+                status=res.status,
+                reason=res.error_message,
+                reason_code=res.error_code,
+                last_state=res.status,
+                tenant_id=res.labels.get("tenant_id", ""),
+            )
+        )
+        # synthesize a result payload for UI reads (reference gateway.go:553-607)
+        if not res.result_ptr:
+            await self.mem.put_result(
+                res.job_id, {"error": res.error_message, "code": res.error_code}
+            )
+
+    async def _tap_events(self, subject: str, pkt: BusPacket) -> None:
+        if not self._ws_clients:
+            return
+        event = json.dumps({"subject": subject, "packet": pkt.to_dict()}, default=str)
+        dead = []
+        for ws in self._ws_clients:
+            try:
+                await ws.send_str(event)
+            except Exception:
+                dead.append(ws)
+        for ws in dead:
+            self._ws_clients.discard(ws)
+
+    # ------------------------------------------------------------------
+    # jobs
+    # ------------------------------------------------------------------
+    async def submit_job(self, request: web.Request) -> web.Response:
+        principal: Principal = request["principal"]
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        topic = str(body.get("topic", ""))
+        if not topic:
+            return _err(400, "topic is required")
+        payload = body.get("payload", body.get("context"))
+        tenant = str(body.get("tenant_id") or principal.tenant_id)
+        job_id = str(body.get("job_id") or new_id())
+
+        idem = str(body.get("idempotency_key") or request.headers.get("Idempotency-Key", ""))
+        if idem:
+            fresh, existing = await self.job_store.try_set_idempotency_key(tenant, idem, job_id)
+            if not fresh:
+                return web.json_response({"job_id": existing, "deduplicated": True})
+
+        labels = {str(k): str(v) for k, v in (body.get("labels") or {}).items()}
+        meta_doc = body.get("metadata") or {}
+        metadata = JobMetadata(
+            capability=str(meta_doc.get("capability", "")),
+            risk_tags=list(meta_doc.get("risk_tags") or []),
+            requires=list(meta_doc.get("requires") or []),
+            pack_id=str(meta_doc.get("pack_id", "")),
+        )
+        if contains_secret_refs(payload) or contains_secret_refs(body.get("env")):
+            labels[LABEL_SECRETS_PRESENT] = "true"
+            if "secrets" not in metadata.risk_tags:
+                metadata.risk_tags.append("secrets")
+
+        ctx_ptr = await self.mem.put_context(job_id, payload)
+        budget = Budget.from_dict(body.get("budget")) if body.get("budget") else None
+        hints = ContextHints.from_dict(body.get("context_hints")) if body.get("context_hints") else None
+        req = JobRequest(
+            job_id=job_id,
+            topic=topic,
+            priority=str(body.get("priority", "BATCH")),
+            context_ptr=ctx_ptr,
+            memory_id=str(body.get("memory_id", "")),
+            tenant_id=tenant,
+            principal_id=principal.principal_id,
+            adapter_id=str(body.get("adapter_id", "")),
+            labels=labels,
+            env={str(k): str(v) for k, v in (body.get("env") or {}).items()},
+            metadata=metadata,
+            budget=budget,
+            context_hints=hints,
+        )
+        trace_id = str(body.get("trace_id") or new_id())
+        await self.job_store.set_state(
+            job_id,
+            JobState.PENDING,
+            fields={
+                "topic": topic,
+                "tenant_id": tenant,
+                "principal_id": principal.principal_id,
+                "context_ptr": ctx_ptr,
+                "trace_id": trace_id,
+                "submitted_at_us": str(now_us()),
+            },
+            event="submit",
+        )
+        await self.job_store.put_request(req)
+        await self.job_store.add_to_trace(trace_id, job_id)
+        await self.bus.publish(
+            subj.SUBMIT, BusPacket.wrap(req, trace_id=trace_id, sender_id=self.instance_id)
+        )
+        return web.json_response({"job_id": job_id, "trace_id": trace_id, "state": "PENDING"}, status=202)
+
+    async def get_job(self, request: web.Request) -> web.Response:
+        job_id = request.match_info["job_id"]
+        meta = await self.job_store.get_meta(job_id)
+        if not meta:
+            return _err(404, f"unknown job {job_id}")
+        out: dict[str, Any] = {"job_id": job_id, **meta}
+        if request.query.get("events") == "true":
+            out["events"] = await self.job_store.events(job_id)
+        if request.query.get("result") == "true" and meta.get("result_ptr"):
+            out["result"] = await self.mem.get_pointer(meta["result_ptr"])
+        return web.json_response(out)
+
+    async def list_jobs(self, request: web.Request) -> web.Response:
+        state = request.query.get("state", "")
+        limit = int(request.query.get("limit", "50"))
+        ids = (
+            await self.job_store.list_by_state(state, limit)
+            if state
+            else await self.job_store.list_recent(limit)
+        )
+        jobs = []
+        for jid in ids:
+            meta = await self.job_store.get_meta(jid)
+            jobs.append({"job_id": jid, "state": meta.get("state"), "topic": meta.get("topic"),
+                         "tenant_id": meta.get("tenant_id")})
+        return web.json_response({"jobs": jobs})
+
+    async def cancel_job(self, request: web.Request) -> web.Response:
+        job_id = request.match_info["job_id"]
+        principal: Principal = request["principal"]
+        if not await self.job_store.get_meta(job_id):
+            return _err(404, f"unknown job {job_id}")
+        await self.bus.publish(
+            subj.CANCEL,
+            BusPacket.wrap(
+                JobCancel(job_id=job_id, reason="api cancel", requested_by=principal.principal_id),
+                sender_id=self.instance_id,
+            ),
+        )
+        return web.json_response({"job_id": job_id, "cancelled": True})
+
+    async def remediate_job(self, request: web.Request) -> web.Response:
+        """Apply a safety-decision remediation: new job with safer topic/
+        capability/labels (reference POST /jobs/{id}/remediate)."""
+        job_id = request.match_info["job_id"]
+        body = await request.json() if request.can_read_body else {}
+        rem_id = str((body or {}).get("remediation_id", ""))
+        decision = await self.job_store.get_safety_decision(job_id)
+        if decision is None or not decision.remediations:
+            return _err(404, "no remediations recorded for this job")
+        rem = next((r for r in decision.remediations if not rem_id or r.get("id") == rem_id), None)
+        if rem is None:
+            return _err(404, f"unknown remediation {rem_id!r}")
+        orig = await self.job_store.get_request(job_id)
+        if orig is None:
+            return _err(404, "original job request not found")
+        new_id_ = new_id()
+        ctx = await self.mem.get_context(orig.context_ptr) if orig.context_ptr else None
+        new_ptr = await self.mem.put_context(new_id_, ctx)
+        labels = {k: v for k, v in orig.labels.items() if k not in (rem.get("remove_labels") or [])}
+        labels.update(rem.get("add_labels") or {})
+        meta = orig.metadata or JobMetadata()
+        new_req = JobRequest(
+            job_id=new_id_,
+            topic=rem.get("replacement_topic") or orig.topic,
+            priority=orig.priority,
+            context_ptr=new_ptr,
+            memory_id=orig.memory_id,
+            tenant_id=orig.tenant_id,
+            principal_id=orig.principal_id,
+            labels=labels,
+            env=dict(orig.env),
+            metadata=JobMetadata(
+                capability=rem.get("replacement_capability") or meta.capability,
+                risk_tags=list(meta.risk_tags),
+                requires=list(meta.requires),
+                pack_id=meta.pack_id,
+            ),
+        )
+        await self.job_store.set_state(
+            new_id_, JobState.PENDING,
+            fields={"topic": new_req.topic, "tenant_id": new_req.tenant_id,
+                    "remediated_from": job_id, "submitted_at_us": str(now_us())},
+            event="remediate",
+        )
+        await self.job_store.put_request(new_req)
+        await self.bus.publish(subj.SUBMIT, BusPacket.wrap(new_req, sender_id=self.instance_id))
+        return web.json_response({"job_id": new_id_, "remediated_from": job_id}, status=202)
+
+    # ------------------------------------------------------------------
+    # approvals (reference gateway.go:3700-3838)
+    # ------------------------------------------------------------------
+    async def list_approvals(self, request: web.Request) -> web.Response:
+        ids = await self.job_store.list_by_state(JobState.APPROVAL_REQUIRED.value, 200)
+        out = []
+        for jid in ids:
+            meta = await self.job_store.get_meta(jid)
+            rec = await self.job_store.get_safety_decision(jid)
+            out.append({
+                "job_id": jid,
+                "topic": meta.get("topic"),
+                "tenant_id": meta.get("tenant_id"),
+                "reason": meta.get("approval_reason", ""),
+                "policy_snapshot": rec.policy_snapshot if rec else "",
+            })
+        return web.json_response({"approvals": out})
+
+    async def approve_job(self, request: web.Request) -> web.Response:
+        principal: Principal = request["principal"]
+        if principal.role != "admin":
+            return _err(403, "approvals require the admin role")
+        job_id = request.match_info["job_id"]
+        state = await self.job_store.get_state(job_id)
+        if state != JobState.APPROVAL_REQUIRED.value:
+            return _err(409, f"job is {state or 'unknown'}, not APPROVAL_REQUIRED")
+        rec = await self.job_store.get_safety_decision(job_id)
+        req = await self.job_store.get_request(job_id)
+        if rec is None or req is None or not rec.job_hash:
+            return _err(409, "no bound safety decision for this job")
+        if rec.job_hash != job_hash(req):
+            return _err(409, "stored request no longer matches the approved content")
+        # re-check against the CURRENT kernel: policy may have tightened
+        fresh = await self.kernel.check(
+            PolicyCheckRequest(
+                job_id=job_id, tenant_id=req.tenant_id, principal_id=req.principal_id,
+                topic=req.topic, labels=dict(req.labels), metadata=req.metadata,
+            )
+        )
+        if fresh.decision == "DENY":
+            return _err(409, f"current policy denies this job: {fresh.reason}")
+        await self.job_store.put_approval(
+            ApprovalRecord(job_id=job_id, approved_by=principal.principal_id, approved=True,
+                           job_hash=rec.job_hash, policy_snapshot=rec.policy_snapshot)
+        )
+        await self.job_store.append_event(job_id, "approved", by=principal.principal_id)
+        republish = JobRequest.from_dict(req.to_dict())
+        republish.labels = dict(republish.labels or {})
+        republish.labels[LABEL_APPROVAL_GRANTED] = "true"
+        republish.labels[LABEL_BUS_MSG_ID] = f"approve-{job_id}-{now_us()}"
+        await self.bus.publish(subj.SUBMIT, BusPacket.wrap(republish, sender_id=self.instance_id))
+        return web.json_response({"job_id": job_id, "approved": True})
+
+    async def reject_job(self, request: web.Request) -> web.Response:
+        principal: Principal = request["principal"]
+        if principal.role != "admin":
+            return _err(403, "approvals require the admin role")
+        job_id = request.match_info["job_id"]
+        state = await self.job_store.get_state(job_id)
+        if state != JobState.APPROVAL_REQUIRED.value:
+            return _err(409, f"job is {state or 'unknown'}, not APPROVAL_REQUIRED")
+        body = await request.json() if request.can_read_body else {}
+        reason = str((body or {}).get("reason", "rejected"))
+        await self.job_store.put_approval(
+            ApprovalRecord(job_id=job_id, approved_by=principal.principal_id, approved=False, reason=reason)
+        )
+        await self.job_store.set_state(
+            job_id, JobState.DENIED, fields={"deny_reason": f"approval rejected: {reason}"},
+            event="approval_rejected",
+        )
+        return web.json_response({"job_id": job_id, "approved": False})
+
+    # ------------------------------------------------------------------
+    # workflows + runs
+    # ------------------------------------------------------------------
+    async def put_workflow(self, request: web.Request) -> web.Response:
+        doc = await request.json()
+        wf = Workflow.from_dict(doc)
+        if not wf.id:
+            wf.id = new_id()
+        errs = wf.validate()
+        if errs:
+            return _err(400, "; ".join(errs))
+        await self.wf_store.put_workflow(wf)
+        return web.json_response({"id": wf.id, "version": wf.version}, status=201)
+
+    async def list_workflows(self, request: web.Request) -> web.Response:
+        ids = await self.wf_store.list_workflows()
+        return web.json_response({"workflows": ids})
+
+    async def get_workflow(self, request: web.Request) -> web.Response:
+        wf = await self.wf_store.get_workflow(request.match_info["wf_id"])
+        if wf is None:
+            return _err(404, "unknown workflow")
+        return web.json_response(wf.to_dict())
+
+    async def delete_workflow(self, request: web.Request) -> web.Response:
+        ok = await self.wf_store.delete_workflow(request.match_info["wf_id"])
+        return web.json_response({"deleted": ok}, status=200 if ok else 404)
+
+    async def start_run(self, request: web.Request) -> web.Response:
+        principal: Principal = request["principal"]
+        wf_id = request.match_info["wf_id"]
+        body = await request.json() if request.can_read_body else {}
+        body = body or {}
+        run = await self.wf_engine.start_run(
+            wf_id,
+            body.get("input"),
+            org_id=str(body.get("org_id") or principal.tenant_id),
+            idempotency_key=request.headers.get("Idempotency-Key", str(body.get("idempotency_key", ""))),
+            dry_run=bool(body.get("dry_run", False)),
+            labels={str(k): str(v) for k, v in (body.get("labels") or {}).items()},
+            max_concurrent_runs=self.max_concurrent_runs,
+        )
+        return web.json_response({"run_id": run.run_id, "status": run.status}, status=202)
+
+    async def list_runs(self, request: web.Request) -> web.Response:
+        ids = await self.wf_store.list_runs(request.query.get("workflow_id", ""))
+        return web.json_response({"runs": ids})
+
+    async def get_run(self, request: web.Request) -> web.Response:
+        run = await self.wf_store.get_run(request.match_info["run_id"])
+        if run is None:
+            return _err(404, "unknown run")
+        return web.json_response(run.to_dict())
+
+    async def cancel_run(self, request: web.Request) -> web.Response:
+        run = await self.wf_engine.cancel_run(request.match_info["run_id"], reason="api cancel")
+        return web.json_response({"run_id": run.run_id, "status": run.status})
+
+    async def rerun(self, request: web.Request) -> web.Response:
+        body = await request.json() if request.can_read_body else {}
+        body = body or {}
+        step_id = str(body.get("from_step", ""))
+        if not step_id:
+            return _err(400, "from_step is required")
+        run = await self.wf_engine.rerun_from(
+            request.match_info["run_id"], step_id, dry_run=bool(body.get("dry_run", False))
+        )
+        return web.json_response({"run_id": run.run_id, "status": run.status}, status=202)
+
+    async def approve_step(self, request: web.Request) -> web.Response:
+        principal: Principal = request["principal"]
+        if principal.role != "admin":
+            return _err(403, "step approvals require the admin role")
+        body = await request.json() if request.can_read_body else {}
+        body = body or {}
+        run = await self.wf_engine.approve_step(
+            request.match_info["run_id"],
+            request.match_info["step_id"],
+            approve=bool(body.get("approve", True)),
+            approved_by=principal.principal_id,
+        )
+        return web.json_response({"run_id": run.run_id, "status": run.status})
+
+    async def run_timeline(self, request: web.Request) -> web.Response:
+        tl = await self.wf_store.timeline(request.match_info["run_id"])
+        return web.json_response({"timeline": tl})
+
+    # ------------------------------------------------------------------
+    # DLQ
+    # ------------------------------------------------------------------
+    async def list_dlq(self, request: web.Request) -> web.Response:
+        offset = int(request.query.get("offset", "0"))
+        limit = int(request.query.get("limit", "50"))
+        entries = await self.dlq.list(offset, limit)
+        return web.json_response({
+            "entries": [e.__dict__ for e in entries],
+            "total": await self.dlq.count(),
+        })
+
+    async def delete_dlq(self, request: web.Request) -> web.Response:
+        ok = await self.dlq.delete(request.match_info["job_id"])
+        return web.json_response({"deleted": ok}, status=200 if ok else 404)
+
+    async def retry_dlq(self, request: web.Request) -> web.Response:
+        """Retry a dead-lettered job under a NEW job id with rehydrated
+        context (reference gateway.go:3452)."""
+        job_id = request.match_info["job_id"]
+        entry = await self.dlq.get(job_id)
+        orig = await self.job_store.get_request(job_id)
+        if entry is None or orig is None:
+            return _err(404, "job not found in DLQ")
+        new_jid = new_id()
+        ctx = await self.mem.get_context(orig.context_ptr) if orig.context_ptr else None
+        new_ptr = await self.mem.put_context(new_jid, ctx)
+        req = JobRequest.from_dict(orig.to_dict())
+        req.job_id = new_jid
+        req.context_ptr = new_ptr
+        req.labels = {k: v for k, v in (req.labels or {}).items() if k != LABEL_BUS_MSG_ID}
+        await self.job_store.set_state(
+            new_jid, JobState.PENDING,
+            fields={"topic": req.topic, "tenant_id": req.tenant_id, "retried_from": job_id,
+                    "submitted_at_us": str(now_us())},
+            event="dlq_retry",
+        )
+        await self.job_store.put_request(req)
+        await self.bus.publish(subj.SUBMIT, BusPacket.wrap(req, sender_id=self.instance_id))
+        await self.dlq.delete(job_id)
+        return web.json_response({"job_id": new_jid, "retried_from": job_id}, status=202)
+
+    # ------------------------------------------------------------------
+    # policy admin
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _policy_check_request(doc: dict) -> PolicyCheckRequest:
+        meta = doc.get("metadata")
+        return PolicyCheckRequest(
+            job_id=str(doc.get("job_id", "")),
+            tenant_id=str(doc.get("tenant_id", "")),
+            principal_id=str(doc.get("principal_id", "")),
+            topic=str(doc.get("topic", "")),
+            labels={str(k): str(v) for k, v in (doc.get("labels") or {}).items()},
+            metadata=JobMetadata.from_dict(meta) if meta else None,
+            actor_id=str(doc.get("actor_id", "")),
+            actor_type=str(doc.get("actor_type", "")),
+            effective_config=doc.get("effective_config") or {},
+        )
+
+    async def policy_evaluate(self, request: web.Request) -> web.Response:
+        doc = await request.json()
+        resp = await self.kernel.evaluate_raw(self._policy_check_request(doc))
+        return web.json_response(resp.to_dict())
+
+    async def policy_simulate(self, request: web.Request) -> web.Response:
+        doc = await request.json()
+        results = await self.kernel.simulate(
+            doc.get("policy") or {},
+            [self._policy_check_request(r) for r in (doc.get("requests") or [])],
+        )
+        return web.json_response({"results": results})
+
+    async def policy_explain(self, request: web.Request) -> web.Response:
+        doc = await request.json()
+        return web.json_response(await self.kernel.explain(self._policy_check_request(doc)))
+
+    async def policy_snapshots(self, request: web.Request) -> web.Response:
+        return web.json_response({"snapshots": self.kernel.list_snapshots(),
+                                  "current": self.kernel.snapshot_id})
+
+    # ------------------------------------------------------------------
+    # config / schemas / locks / artifacts / memory / traces
+    # ------------------------------------------------------------------
+    async def config_get(self, request: web.Request) -> web.Response:
+        if self.configsvc is None:
+            return _err(501, "config service not wired")
+        doc = await self.configsvc.get(request.match_info["scope"], request.match_info["doc_id"])
+        if doc is None:
+            return _err(404, "unknown config doc")
+        return web.json_response({"scope": doc.scope, "id": doc.doc_id, "revision": doc.revision,
+                                  "data": doc.data})
+
+    async def config_set(self, request: web.Request) -> web.Response:
+        principal: Principal = request["principal"]
+        if principal.role != "admin":
+            return _err(403, "config writes require the admin role")
+        if self.configsvc is None:
+            return _err(501, "config service not wired")
+        body = await request.json()
+        scope, doc_id = request.match_info["scope"], request.match_info["doc_id"]
+        if body.get("patch"):
+            doc = await self.configsvc.patch(scope, doc_id, body["patch"])
+        else:
+            doc = await self.configsvc.set(scope, doc_id, body.get("data") or {})
+        await self.kernel.reload()  # policy fragments may have changed
+        return web.json_response({"scope": scope, "id": doc_id, "revision": doc.revision})
+
+    async def config_effective(self, request: web.Request) -> web.Response:
+        if self.configsvc is None:
+            return _err(501, "config service not wired")
+        q = request.query
+        eff = await self.configsvc.effective(
+            org=q.get("org", ""), team=q.get("team", ""),
+            workflow=q.get("workflow", ""), step=q.get("step", ""),
+        )
+        return web.json_response({"effective": eff})
+
+    async def list_schemas(self, request: web.Request) -> web.Response:
+        return web.json_response({"schemas": await self.schemas.list()})
+
+    async def get_schema(self, request: web.Request) -> web.Response:
+        s = await self.schemas.get(request.match_info["schema_id"])
+        if s is None:
+            return _err(404, "unknown schema")
+        return web.json_response(s)
+
+    async def put_schema(self, request: web.Request) -> web.Response:
+        await self.schemas.put(request.match_info["schema_id"], await request.json())
+        return web.json_response({"id": request.match_info["schema_id"]}, status=201)
+
+    async def delete_schema(self, request: web.Request) -> web.Response:
+        ok = await self.schemas.delete(request.match_info["schema_id"])
+        return web.json_response({"deleted": ok}, status=200 if ok else 404)
+
+    async def list_locks(self, request: web.Request) -> web.Response:
+        infos = await self.locks.list()
+        return web.json_response({"locks": [i.__dict__ for i in infos]})
+
+    async def acquire_lock(self, request: web.Request) -> web.Response:
+        body = await request.json() if request.can_read_body else {}
+        body = body or {}
+        principal: Principal = request["principal"]
+        ok = await self.locks.acquire(
+            request.match_info["resource"],
+            str(body.get("owner") or principal.principal_id),
+            mode=str(body.get("mode", "exclusive")),
+            ttl_s=float(body.get("ttl_s", 30.0)),
+        )
+        return web.json_response({"acquired": ok}, status=200 if ok else 409)
+
+    async def release_lock(self, request: web.Request) -> web.Response:
+        body = await request.json() if request.can_read_body else {}
+        body = body or {}
+        principal: Principal = request["principal"]
+        ok = await self.locks.release(
+            request.match_info["resource"], str(body.get("owner") or principal.principal_id)
+        )
+        return web.json_response({"released": ok}, status=200 if ok else 404)
+
+    async def put_artifact(self, request: web.Request) -> web.Response:
+        data = await request.read()
+        meta = await self.artifacts.put(
+            data,
+            content_type=request.content_type or "application/octet-stream",
+            retention=request.query.get("retention", "standard"),
+        )
+        return web.json_response(
+            {"artifact_id": meta.artifact_id, "pointer": self.artifacts.pointer(meta.artifact_id),
+             "size": meta.size},
+            status=201,
+        )
+
+    async def get_artifact(self, request: web.Request) -> web.Response:
+        data, meta = await self.artifacts.get(request.match_info["artifact_id"])
+        if data is None:
+            return _err(404, "unknown artifact")
+        return web.Response(body=data, content_type=meta.content_type if meta else "application/octet-stream")
+
+    async def read_pointer(self, request: web.Request) -> web.Response:
+        ptr = request.query.get("ptr", "")
+        if not ptr:
+            return _err(400, "ptr query param required")
+        value = await self.mem.get_pointer(ptr)
+        if value is None:
+            return _err(404, "pointer not found")
+        return web.json_response({"ptr": ptr, "value": value})
+
+    async def get_trace(self, request: web.Request) -> web.Response:
+        trace_id = request.match_info["trace_id"]
+        job_ids = sorted(await self.job_store.trace(trace_id))
+        jobs = []
+        for jid in job_ids:
+            meta = await self.job_store.get_meta(jid)
+            jobs.append({"job_id": jid, "state": meta.get("state"), "topic": meta.get("topic")})
+        return web.json_response({"trace_id": trace_id, "jobs": jobs})
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    async def get_workers(self, request: web.Request) -> web.Response:
+        if self.registry is not None:
+            return web.json_response(self.registry.snapshot_json())
+        snap = await self.kv.get("sys:workers:snapshot")
+        return web.json_response(json.loads(snap) if snap else {"workers": {}, "count": 0})
+
+    async def get_status(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "bus": await self.bus.ping(),
+            "kv": await self.kv.ping(),
+            "policy_snapshot": self.kernel.snapshot_id,
+            "workers": len(self.registry.snapshot()) if self.registry else None,
+            "ws_clients": len(self._ws_clients),
+        })
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def get_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(), content_type="text/plain")
+
+    async def ws_stream(self, request: web.Request) -> web.WebSocketResponse:
+        origin = request.headers.get("Origin", "")
+        if self.ws_allowed_origins is not None and origin and origin not in self.ws_allowed_origins:
+            raise web.HTTPForbidden(reason="origin not allowed")
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        self._ws_clients.add(ws)
+        try:
+            async for msg in ws:
+                if msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                    break
+        finally:
+            self._ws_clients.discard(ws)
+        return ws

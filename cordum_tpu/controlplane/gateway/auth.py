@@ -1,0 +1,75 @@
+"""Gateway auth: pluggable provider, OSS flat API-key allowlist
+(reference ``core/controlplane/gateway/basic_auth.go`` + ``auth_provider.go``).
+
+The OSS provider trusts ``X-Principal-Id`` / ``X-Principal-Role`` headers
+once the API key checks out (single-tenant mode unless the key map assigns
+tenants).  Enterprise RBAC is explicitly out of scope (reference keeps it
+out-of-repo too).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class Principal:
+    principal_id: str = "anonymous"
+    role: str = "user"  # user | admin
+    tenant_id: str = "default"
+    authenticated: bool = False
+
+
+class AuthProvider:
+    def authenticate(self, headers) -> Optional[Principal]:
+        raise NotImplementedError
+
+
+class BasicAuthProvider(AuthProvider):
+    """Flat API-key allowlist; empty key list = open (dev mode)."""
+
+    def __init__(self, api_keys: Optional[list[str]] = None, *, admin_keys: Optional[list[str]] = None,
+                 default_tenant: str = "default"):
+        self.api_keys = set(api_keys or [])
+        self.admin_keys = set(admin_keys or [])
+        self.default_tenant = default_tenant
+
+    def authenticate(self, headers) -> Optional[Principal]:
+        key = headers.get("X-Api-Key", "")
+        auth = headers.get("Authorization", "")
+        if not key and auth.startswith("Bearer "):
+            key = auth[len("Bearer "):]
+        if self.api_keys and key not in self.api_keys and key not in self.admin_keys:
+            return None
+        role = headers.get("X-Principal-Role", "")
+        if key and key in self.admin_keys:
+            role = role or "admin"
+        return Principal(
+            principal_id=headers.get("X-Principal-Id", "anonymous"),
+            role=role or "user",
+            tenant_id=headers.get("X-Tenant-Id", self.default_tenant),
+            authenticated=bool(key) or not self.api_keys,
+        )
+
+
+class TokenBucket:
+    """Per-key token bucket (reference gateway rate limiting,
+    ``API_RATE_LIMIT_RPS/BURST``)."""
+
+    def __init__(self, rps: float = 0.0, burst: int = 0):
+        self.rps = rps
+        self.burst = burst or int(rps * 2) or 1
+        self._state: dict[str, tuple[float, float]] = {}
+
+    def allow(self, key: str) -> bool:
+        if self.rps <= 0:
+            return True
+        now = time.monotonic()
+        tokens, last = self._state.get(key, (float(self.burst), now))
+        tokens = min(self.burst, tokens + (now - last) * self.rps)
+        if tokens < 1.0:
+            self._state[key] = (tokens, now)
+            return False
+        self._state[key] = (tokens - 1.0, now)
+        return True

@@ -126,6 +126,10 @@ class LeastLoadedStrategy(Strategy):
         workers = self.registry.snapshot()
 
         pools = self._pool_config.pools_for_topic(req.topic)
+        if not pools:
+            # topic not mapped to any pool: fan-in on the topic subject —
+            # never direct-dispatch to workers whose pools don't serve it
+            return req.topic
         placement = _placement_labels(labels)
 
         # direct worker hint — still subject to capability/placement checks so

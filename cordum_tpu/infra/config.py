@@ -73,7 +73,15 @@ class PoolConfig:
     pools: dict[str, Pool] = field(default_factory=dict)
 
     def pools_for_topic(self, topic: str) -> list[Pool]:
-        names = self.topics.get(topic, [])
+        names = self.topics.get(topic)
+        if names is None:
+            # wildcard topic keys (e.g. "job.tpu.>") match like bus subjects
+            from ..utils.globmatch import subject_match
+
+            names = []
+            for pattern, pool_names in self.topics.items():
+                if subject_match(pattern, topic):
+                    names.extend(pool_names)
         return [self.pools[n] for n in names if n in self.pools]
 
 

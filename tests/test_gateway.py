@@ -1,0 +1,385 @@
+"""Gateway HTTP tests (httptest equivalent): jobs, approvals, workflows,
+runs, DLQ, policy, config, schemas, locks, artifacts, traces, status, WS."""
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from cordum_tpu.controlplane.gateway.app import Gateway
+from cordum_tpu.controlplane.gateway.auth import BasicAuthProvider, TokenBucket
+from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+from cordum_tpu.controlplane.scheduler.engine import Engine as Scheduler
+from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+from cordum_tpu.controlplane.workflowengine.service import WorkflowEngineService
+from cordum_tpu.infra.bus import LoopbackBus
+from cordum_tpu.infra.config import parse_pool_config
+from cordum_tpu.infra.configsvc import ConfigService
+from cordum_tpu.infra.jobstore import JobStore
+from cordum_tpu.infra.kv import MemoryKV
+from cordum_tpu.infra.memstore import MemoryStore
+from cordum_tpu.infra.registry import WorkerRegistry
+from cordum_tpu.infra.schemareg import SchemaRegistry
+from cordum_tpu.protocol import subjects as subj
+from cordum_tpu.workflow.engine import Engine as WorkflowEngine
+from cordum_tpu.workflow.store import WorkflowStore
+from cordum_tpu.worker.runtime import JobContext, Worker
+
+POLICY = {
+    "default_tenant": "default",
+    "tenants": {"default": {"allow_topics": ["job.*", "job.>"]}},
+    "rules": [
+        {"id": "approve-deploy", "match": {"topics": ["job.deploy.*"]}, "decision": "require_approval",
+         "remediations": [{"id": "use-staging", "replacement_topic": "job.work",
+                           "add_labels": {"env": "staging"}}]},
+    ],
+}
+
+
+class GwStack:
+    """Full control plane behind a live HTTP server."""
+
+    def __init__(self):
+        self.kv = MemoryKV()
+        self.bus = LoopbackBus()
+        self.job_store = JobStore(self.kv)
+        self.mem = MemoryStore(self.kv)
+        self.wf_store = WorkflowStore(self.kv)
+        self.schemas = SchemaRegistry(self.kv)
+        self.configsvc = ConfigService(self.kv)
+        self.kernel = SafetyKernel(policy_doc=POLICY, configsvc=self.configsvc)
+        self.registry = WorkerRegistry()
+        pc = parse_pool_config({"topics": {"job.work": "p"}, "pools": {"p": {}}})
+        self.scheduler = Scheduler(
+            bus=self.bus, job_store=self.job_store, safety=SafetyClient(self.kernel.check),
+            strategy=LeastLoadedStrategy(self.registry, pc), registry=self.registry,
+        )
+        self.wf_engine = WorkflowEngine(store=self.wf_store, bus=self.bus, mem=self.mem,
+                                        schemas=self.schemas, configsvc=self.configsvc)
+        self.wf_service = WorkflowEngineService(engine=self.wf_engine, bus=self.bus,
+                                                job_store=self.job_store, reconcile_interval_s=0.1)
+        self.gw = Gateway(
+            kv=self.kv, bus=self.bus, job_store=self.job_store, mem=self.mem,
+            kernel=self.kernel, wf_store=self.wf_store, wf_engine=self.wf_engine,
+            schemas=self.schemas, configsvc=self.configsvc, registry=self.registry,
+            auth=BasicAuthProvider(["user-key"], admin_keys=["admin-key"]),
+        )
+        self.worker = Worker(bus=self.bus, store=self.mem, worker_id="w1", pool="p",
+                             topics=["job.work"], heartbeat_interval_s=999)
+        self.client: TestClient = None
+
+    async def __aenter__(self):
+        async def handler(ctx: JobContext):
+            p = ctx.payload if isinstance(ctx.payload, dict) else {}
+            if p.get("fail"):
+                raise RuntimeError("worker failure requested")
+            return {"done": True, "echo": p}
+
+        self.worker.register("job.work", handler)
+        await self.kernel.reload()
+        await self.scheduler.start()
+        await self.wf_service.start()
+        await self.worker.start()
+        # bus taps only (no TCP listen needed for TestServer)
+        self.gw._subs.append(await self.bus.subscribe(subj.DLQ, self.gw._tap_dlq))
+        self.gw._subs.append(await self.bus.subscribe("sys.job.>", self.gw._tap_events))
+        self.client = TestClient(TestServer(self.gw.app))
+        await self.client.start_server()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.client.close()
+        await self.worker.stop()
+        await self.wf_service.stop()
+        await self.scheduler.stop()
+        for s in self.gw._subs:
+            s.unsubscribe()
+        await self.bus.close()
+
+    async def settle(self, rounds=10):
+        for _ in range(rounds):
+            await self.bus.drain()
+            await asyncio.sleep(0.01)
+
+    def h(self, admin=False, **extra):
+        return {"X-Api-Key": "admin-key" if admin else "user-key", **extra}
+
+
+async def test_auth_required():
+    async with GwStack() as s:
+        r = await s.client.get("/api/v1/jobs")
+        assert r.status == 401
+        r = await s.client.get("/api/v1/jobs", headers=s.h())
+        assert r.status == 200
+
+
+async def test_job_submit_roundtrip():
+    async with GwStack() as s:
+        r = await s.client.post("/api/v1/jobs", json={"topic": "job.work", "payload": {"n": 1}},
+                                headers=s.h())
+        assert r.status == 202
+        jid = (await r.json())["job_id"]
+        await s.settle()
+        r = await s.client.get(f"/api/v1/jobs/{jid}?events=true&result=true", headers=s.h())
+        doc = await r.json()
+        assert doc["state"] == "SUCCEEDED"
+        assert doc["result"] == {"done": True, "echo": {"n": 1}}
+        assert any(e["event"] == "submit" for e in doc["events"])
+        # trace reader
+        r = await s.client.get(f"/api/v1/traces/{doc['trace_id']}", headers=s.h())
+        tr = await r.json()
+        assert tr["jobs"][0]["job_id"] == jid
+
+
+async def test_job_submit_validation_and_idempotency():
+    async with GwStack() as s:
+        r = await s.client.post("/api/v1/jobs", json={"payload": {}}, headers=s.h())
+        assert r.status == 400
+        r = await s.client.post("/api/v1/jobs", data=b"not json", headers=s.h())
+        assert r.status == 400
+        r1 = await s.client.post("/api/v1/jobs", json={"topic": "job.work", "idempotency_key": "k1"},
+                                 headers=s.h())
+        r2 = await s.client.post("/api/v1/jobs", json={"topic": "job.work", "idempotency_key": "k1"},
+                                 headers=s.h())
+        j1, j2 = (await r1.json()), (await r2.json())
+        assert j1["job_id"] == j2["job_id"] and j2.get("deduplicated")
+
+
+async def test_secret_detection_labels():
+    async with GwStack() as s:
+        r = await s.client.post(
+            "/api/v1/jobs",
+            json={"topic": "job.work", "payload": {"token": "secret://vault/x"}},
+            headers=s.h(),
+        )
+        jid = (await r.json())["job_id"]
+        req = await s.job_store.get_request(jid)
+        assert req.labels.get("secrets_present") == "true"
+        assert "secrets" in req.metadata.risk_tags
+
+
+async def test_approval_flow_over_http():
+    async with GwStack() as s:
+        r = await s.client.post("/api/v1/jobs", json={"topic": "job.deploy.api", "payload": {}},
+                                headers=s.h())
+        jid = (await r.json())["job_id"]
+        await s.settle()
+        r = await s.client.get(f"/api/v1/jobs/{jid}", headers=s.h())
+        assert (await r.json())["state"] == "APPROVAL_REQUIRED"
+        r = await s.client.get("/api/v1/approvals", headers=s.h())
+        approvals = (await r.json())["approvals"]
+        assert any(a["job_id"] == jid for a in approvals)
+        # non-admin cannot approve
+        r = await s.client.post(f"/api/v1/approvals/{jid}/approve", headers=s.h())
+        assert r.status == 403
+        # admin approves; job dispatches (topic job.deploy.api has no pool;
+        # falls back to topic subject, no worker → stays RUNNING)
+        r = await s.client.post(f"/api/v1/approvals/{jid}/approve", headers=s.h(admin=True))
+        assert r.status == 200
+        await s.settle()
+        r = await s.client.get(f"/api/v1/jobs/{jid}", headers=s.h())
+        assert (await r.json())["state"] == "RUNNING"
+        rec = await s.job_store.get_approval(jid)
+        assert rec.approved and rec.approved_by == "anonymous"
+
+
+async def test_reject_flow_over_http():
+    async with GwStack() as s:
+        r = await s.client.post("/api/v1/jobs", json={"topic": "job.deploy.x", "payload": {}},
+                                headers=s.h())
+        jid = (await r.json())["job_id"]
+        await s.settle()
+        r = await s.client.post(f"/api/v1/approvals/{jid}/reject", json={"reason": "too risky"},
+                                headers=s.h(admin=True))
+        assert r.status == 200
+        r = await s.client.get(f"/api/v1/jobs/{jid}", headers=s.h())
+        doc = await r.json()
+        assert doc["state"] == "DENIED" and "too risky" in doc["deny_reason"]
+
+
+async def test_remediation_applies():
+    async with GwStack() as s:
+        r = await s.client.post("/api/v1/jobs", json={"topic": "job.deploy.api", "payload": {"x": 1}},
+                                headers=s.h())
+        jid = (await r.json())["job_id"]
+        await s.settle()
+        r = await s.client.post(f"/api/v1/jobs/{jid}/remediate",
+                                json={"remediation_id": "use-staging"}, headers=s.h())
+        assert r.status == 202
+        new_jid = (await r.json())["job_id"]
+        await s.settle()
+        r = await s.client.get(f"/api/v1/jobs/{new_jid}?result=true", headers=s.h())
+        doc = await r.json()
+        assert doc["state"] == "SUCCEEDED"  # remediated to job.work → worker ran it
+        req = await s.job_store.get_request(new_jid)
+        assert req.labels["env"] == "staging"
+
+
+async def test_dlq_list_and_retry():
+    async with GwStack() as s:
+        r = await s.client.post("/api/v1/jobs", json={"topic": "job.work", "payload": {"fail": True}},
+                                headers=s.h())
+        jid = (await r.json())["job_id"]
+        await s.settle()
+        r = await s.client.get("/api/v1/dlq", headers=s.h())
+        doc = await r.json()
+        assert doc["total"] >= 1 and any(e["job_id"] == jid for e in doc["entries"])
+        # retry under a new job id with a now-passing payload? payload is
+        # rehydrated as-is, so it fails again — but the retry mechanics work
+        r = await s.client.post(f"/api/v1/dlq/{jid}/retry", headers=s.h())
+        assert r.status == 202
+        new_jid = (await r.json())["job_id"]
+        assert new_jid != jid
+        await s.settle()
+        meta = await s.job_store.get_meta(new_jid)
+        assert meta["retried_from"] == jid
+        r = await s.client.delete(f"/api/v1/dlq/{new_jid}", headers=s.h())
+
+
+async def test_workflow_api_end_to_end():
+    async with GwStack() as s:
+        wf = {"id": "wf-http", "name": "t",
+              "steps": {"a": {"topic": "job.work", "input": {"n": "${input.n}"}}}}
+        r = await s.client.post("/api/v1/workflows", json=wf, headers=s.h())
+        assert r.status == 201
+        r = await s.client.get("/api/v1/workflows", headers=s.h())
+        assert "wf-http" in (await r.json())["workflows"]
+        r = await s.client.post("/api/v1/workflows/wf-http/runs", json={"input": {"n": 5}},
+                                headers=s.h(), )
+        assert r.status == 202
+        run_id = (await r.json())["run_id"]
+        for _ in range(50):
+            await s.settle(rounds=2)
+            r = await s.client.get(f"/api/v1/runs/{run_id}", headers=s.h())
+            doc = await r.json()
+            if doc["status"] in ("SUCCEEDED", "FAILED"):
+                break
+        assert doc["status"] == "SUCCEEDED"
+        assert doc["context"]["steps"]["a"] == {"done": True, "echo": {"n": 5}}
+        r = await s.client.get(f"/api/v1/runs/{run_id}/timeline", headers=s.h())
+        assert any(e["event"] == "run_started" for e in (await r.json())["timeline"])
+
+
+async def test_workflow_invalid_rejected():
+    async with GwStack() as s:
+        wf = {"id": "bad", "steps": {"a": {"topic": "t", "depends_on": ["zzz"]}}}
+        r = await s.client.post("/api/v1/workflows", json=wf, headers=s.h())
+        assert r.status == 400
+
+
+async def test_run_idempotency_header():
+    async with GwStack() as s:
+        wf = {"id": "wf2", "steps": {"a": {"topic": "job.work"}}}
+        await s.client.post("/api/v1/workflows", json=wf, headers=s.h())
+        r1 = await s.client.post("/api/v1/workflows/wf2/runs", json={},
+                                 headers=s.h(**{"Idempotency-Key": "run-1"}))
+        r2 = await s.client.post("/api/v1/workflows/wf2/runs", json={},
+                                 headers=s.h(**{"Idempotency-Key": "run-1"}))
+        assert (await r1.json())["run_id"] == (await r2.json())["run_id"]
+
+
+async def test_policy_admin_endpoints():
+    async with GwStack() as s:
+        r = await s.client.post("/api/v1/policy/evaluate",
+                                json={"topic": "job.deploy.api"}, headers=s.h())
+        assert (await r.json())["decision"] == "REQUIRE_APPROVAL"
+        r = await s.client.post("/api/v1/policy/explain",
+                                json={"topic": "job.deploy.api"}, headers=s.h())
+        doc = await r.json()
+        assert any(t["matched"] for t in doc["trail"])
+        r = await s.client.post("/api/v1/policy/simulate", json={
+            "policy": {"rules": [{"id": "d", "match": {"topics": ["job.*"]}, "decision": "deny"}]},
+            "requests": [{"topic": "job.x"}],
+        }, headers=s.h())
+        assert (await r.json())["results"][0]["decision"] == "DENY"
+        r = await s.client.get("/api/v1/policy/snapshots", headers=s.h())
+        assert (await r.json())["current"]
+
+
+async def test_config_endpoints_and_policy_fragment_reload():
+    async with GwStack() as s:
+        r = await s.client.put("/api/v1/config/system/default",
+                               json={"data": {"models": {"default_model": "llama"}}}, headers=s.h())
+        assert r.status == 403  # non-admin
+        r = await s.client.put("/api/v1/config/system/default",
+                               json={"data": {"models": {"default_model": "llama"}}},
+                               headers=s.h(admin=True))
+        assert r.status == 200
+        r = await s.client.get("/api/v1/config/effective", headers=s.h())
+        assert (await r.json())["effective"]["models"]["default_model"] == "llama"
+        # installing a policy fragment via config triggers kernel reload
+        snap_before = s.kernel.snapshot_id
+        r = await s.client.put("/api/v1/config/system/policy/frag1",
+                               json={"data": {"enabled": True,
+                                              "rules": [{"id": "f", "match": {"topics": ["job.frag"]},
+                                                         "decision": "deny"}]}},
+                               headers=s.h(admin=True))
+        assert r.status == 200
+        assert s.kernel.snapshot_id != snap_before
+
+
+async def test_schema_lock_artifact_memory_endpoints():
+    async with GwStack() as s:
+        r = await s.client.put("/api/v1/schemas/s1",
+                               json={"type": "object", "required": ["x"]}, headers=s.h())
+        assert r.status == 201
+        r = await s.client.get("/api/v1/schemas/s1", headers=s.h())
+        assert (await r.json())["required"] == ["x"]
+        r = await s.client.post("/api/v1/locks/res1/acquire", json={"owner": "me"}, headers=s.h())
+        assert (await r.json())["acquired"]
+        r = await s.client.post("/api/v1/locks/res1/acquire", json={"owner": "you"}, headers=s.h())
+        assert r.status == 409
+        r = await s.client.get("/api/v1/locks", headers=s.h())
+        assert len((await r.json())["locks"]) == 1
+        r = await s.client.post("/api/v1/locks/res1/release", json={"owner": "me"}, headers=s.h())
+        assert (await r.json())["released"]
+        r = await s.client.post("/api/v1/artifacts?retention=short", data=b"blob", headers=s.h())
+        aid = (await r.json())["artifact_id"]
+        r = await s.client.get(f"/api/v1/artifacts/{aid}", headers=s.h())
+        assert await r.read() == b"blob"
+        # memory pointer reader
+        ptr = await s.mem.put_context("jx", {"v": 1})
+        r = await s.client.get(f"/api/v1/memory?ptr={ptr}", headers=s.h())
+        assert (await r.json())["value"] == {"v": 1}
+
+
+async def test_status_metrics_workers():
+    async with GwStack() as s:
+        await s.worker.send_heartbeat()
+        await s.settle()
+        r = await s.client.get("/api/v1/workers", headers=s.h())
+        doc = await r.json()
+        assert "w1" in doc["workers"]
+        r = await s.client.get("/api/v1/status", headers=s.h())
+        st = await r.json()
+        assert st["bus"] and st["kv"] and st["policy_snapshot"]
+        r = await s.client.get("/metrics", headers=s.h())
+        text = await r.text()
+        assert "cordum_http_requests_total" in text
+        r = await s.client.get("/healthz")
+        assert r.status == 200
+
+
+async def test_ws_stream_broadcast():
+    async with GwStack() as s:
+        ws = await s.client.ws_connect("/api/v1/stream", headers=s.h())
+        await s.client.post("/api/v1/jobs", json={"topic": "job.work", "payload": {}},
+                            headers=s.h())
+        msg = await asyncio.wait_for(ws.receive_json(), 5)
+        assert msg["subject"].startswith("sys.job.")
+        await ws.close()
+
+
+async def test_job_cancel_endpoint():
+    async with GwStack() as s:
+        # submit to a topic with no worker so it stays RUNNING
+        r = await s.client.post("/api/v1/jobs", json={"topic": "job.nopool", "payload": {}},
+                                headers=s.h())
+        jid = (await r.json())["job_id"]
+        await s.settle()
+        r = await s.client.post(f"/api/v1/jobs/{jid}/cancel", headers=s.h())
+        assert r.status == 200
+        await s.settle()
+        meta = await s.job_store.get_meta(jid)
+        assert meta["state"] == "CANCELLED"
