@@ -1,0 +1,251 @@
+"""Context engine: memory-backed window building with TPU embedding recall.
+
+Recreates the reference context engine's API (``core/context/engine/
+service.go:55-176``): ``build_window(memory_id, mode, payload, budgets)`` →
+list of model messages; ``update_memory`` appends chat events/summaries.
+Memory lives under ``mem:<memory_id>:*`` keys.
+
+TPU-native upgrade (the north-star headline): RAG recall is *semantic* —
+chunks are embedded on the TPU worker pool (or a local embedder) and ranked
+by cosine similarity against the query, instead of the reference's
+substring ``file_path`` matching.  Embeddings are cached per chunk in the
+KV store so re-indexing is incremental.
+
+Token budget trimming keeps the reference's 4-chars≈1-token estimate.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..infra.kv import KV
+
+HISTORY_WINDOW = 20  # last-N chat events (reference service.go:55-132)
+HISTORY_CAP = 500
+DEFAULT_MAX_INPUT_TOKENS = 4000
+
+MODE_RAW = "RAW"
+MODE_CHAT = "CHAT"
+MODE_RAG = "RAG"
+
+
+@dataclass
+class ModelMessage:
+    role: str = "user"
+    content: str = ""
+    source: str = ""
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+def estimate_tokens(text: str) -> int:
+    """Reference estimate: 4 chars ≈ 1 token (service.go:271)."""
+    return max(1, len(text) // 4)
+
+
+def _events_key(memory_id: str) -> str:
+    return f"mem:{memory_id}:events"
+
+
+def _summary_key(memory_id: str) -> str:
+    return f"mem:{memory_id}:summary"
+
+
+def _chunks_key(memory_id: str) -> str:
+    return f"mem:{memory_id}:chunks"
+
+
+def _embed_key(memory_id: str, chunk_hash: str) -> str:
+    return f"mem:{memory_id}:embed:{chunk_hash}"
+
+
+class EmbedFn:
+    """Anything with embed(texts) -> array[N, D]; the Embedder model or a
+    TPU-pool-dispatching client."""
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:  # pragma: no cover
+        raise NotImplementedError
+
+
+class ContextService:
+    def __init__(self, kv: KV, *, embedder: Optional[Any] = None, max_chunks: int = 10):
+        self.kv = kv
+        self.embedder = embedder
+        self.max_chunks = max_chunks
+
+    # ------------------------------------------------------------------
+    async def update_memory(
+        self,
+        memory_id: str,
+        *,
+        user_payload: Any = None,
+        model_response: str = "",
+        mode: str = MODE_CHAT,
+    ) -> None:
+        """Append chat events (RPUSH + LTRIM, reference :134-176)."""
+        if user_payload is not None:
+            ev = {"role": "user", "content": _as_text(user_payload)}
+            await self.kv.rpush(_events_key(memory_id), json.dumps(ev).encode())
+        if model_response:
+            ev = {"role": "assistant", "content": model_response}
+            await self.kv.rpush(_events_key(memory_id), json.dumps(ev).encode())
+        await self.kv.ltrim(_events_key(memory_id), -HISTORY_CAP, -1)
+
+    async def set_summary(self, memory_id: str, summary: str) -> None:
+        await self.kv.set(_summary_key(memory_id), summary.encode())
+
+    async def put_chunks(self, memory_id: str, chunks: list[dict[str, Any]]) -> int:
+        """Store RAG chunks [{file_path, content, labels?}]; embeds them
+        (incrementally — cached by content hash) when an embedder is wired."""
+        await self.kv.set(_chunks_key(memory_id), json.dumps(chunks).encode())
+        if self.embedder is None:
+            return 0
+        missing: list[tuple[str, str]] = []
+        for c in chunks:
+            h = _chunk_hash(c)
+            if await self.kv.get(_embed_key(memory_id, h)) is None:
+                missing.append((h, _chunk_text(c)))
+        if missing:
+            vecs = self.embedder.embed([t for _, t in missing])
+            for (h, _), v in zip(missing, np.asarray(vecs)):
+                await self.kv.set(
+                    _embed_key(memory_id, h), np.asarray(v, np.float32).tobytes()
+                )
+        return len(missing)
+
+    # ------------------------------------------------------------------
+    async def build_window(
+        self,
+        memory_id: str,
+        *,
+        mode: str = MODE_RAW,
+        payload: Any = None,
+        max_input_tokens: int = DEFAULT_MAX_INPUT_TOKENS,
+    ) -> list[ModelMessage]:
+        """RAW: payload only.  CHAT: last-20 history + payload.  RAG: ranked
+        chunks (semantic when embedder present, else path/substring match)
+        + summary fallback + history + payload."""
+        msgs: list[ModelMessage] = []
+        query = _as_text(payload)
+        if mode in (MODE_CHAT, MODE_RAG):
+            raw = await self.kv.lrange(_events_key(memory_id), -HISTORY_WINDOW, -1)
+            for b in raw:
+                try:
+                    ev = json.loads(b)
+                except ValueError:
+                    continue
+                msgs.append(ModelMessage(role=ev.get("role", "user"), content=ev.get("content", ""), source="history"))
+        if mode == MODE_RAG:
+            chunks = await self._rank_chunks(memory_id, query)
+            if chunks:
+                # reversed so the best-ranked chunk ends up first in the window
+                for c, score in reversed(chunks):
+                    msgs.insert(
+                        0,
+                        ModelMessage(
+                            role="system",
+                            content=f"[{c.get('file_path', 'chunk')}] {_chunk_text(c)}",
+                            source=f"rag:{score:.3f}",
+                        ),
+                    )
+            else:
+                summary = await self.kv.get(_summary_key(memory_id))
+                if summary:
+                    msgs.insert(0, ModelMessage(role="system", content=summary.decode(), source="summary"))
+        if payload is not None:
+            msgs.append(ModelMessage(role="user", content=query, source="payload"))
+        return trim_to_budget(msgs, max_input_tokens)
+
+    async def _rank_chunks(self, memory_id: str, query: str) -> list[tuple[dict, float]]:
+        b = await self.kv.get(_chunks_key(memory_id))
+        if not b:
+            return []
+        chunks = json.loads(b)
+        if not chunks:
+            return []
+        if self.embedder is not None and query:
+            qv = np.asarray(self.embedder.embed([query]))[0]
+            scored = []
+            to_embed: list[tuple[int, str]] = []
+            vecs: dict[int, np.ndarray] = {}
+            for i, c in enumerate(chunks):
+                cached = await self.kv.get(_embed_key(memory_id, _chunk_hash(c)))
+                if cached is not None:
+                    vecs[i] = np.frombuffer(cached, np.float32)
+                else:
+                    to_embed.append((i, _chunk_text(c)))
+            if to_embed:
+                new_vecs = np.asarray(self.embedder.embed([t for _, t in to_embed]))
+                for (i, _), v in zip(to_embed, new_vecs):
+                    vecs[i] = np.asarray(v, np.float32)
+                    await self.kv.set(
+                        _embed_key(memory_id, _chunk_hash(chunks[i])), vecs[i].tobytes()
+                    )
+            for i, c in enumerate(chunks):
+                v = vecs[i]
+                denom = float(np.linalg.norm(qv) * np.linalg.norm(v)) or 1.0
+                scored.append((c, float(qv @ v) / denom))
+            scored.sort(key=lambda cs: cs[1], reverse=True)
+            return scored[: self.max_chunks]
+        # lexical fallback (reference behavior: file_path substring match)
+        q = query.lower()
+        hits = [
+            (c, 1.0)
+            for c in chunks
+            if q and (str(c.get("file_path", "")).lower() in q or _overlap(q, _chunk_text(c)))
+        ]
+        return hits[: self.max_chunks]
+
+
+def trim_to_budget(msgs: list[ModelMessage], max_tokens: int) -> list[ModelMessage]:
+    """Drop oldest non-payload messages until under budget (reference
+    trimToBudget :279-296)."""
+    if max_tokens <= 0:
+        return msgs
+    total = sum(estimate_tokens(m.content) for m in msgs)
+    out = list(msgs)
+    i = 0
+    while total > max_tokens and i < len(out):
+        if out[i].source == "payload":
+            i += 1
+            continue
+        total -= estimate_tokens(out[i].content)
+        out.pop(i)
+    # a single over-budget payload gets hard-truncated
+    if total > max_tokens and out:
+        last = out[-1]
+        keep = max_tokens * 4
+        out[-1] = ModelMessage(role=last.role, content=last.content[:keep], source=last.source)
+    return out
+
+
+def _as_text(payload: Any) -> str:
+    if payload is None:
+        return ""
+    if isinstance(payload, str):
+        return payload
+    try:
+        return json.dumps(payload)
+    except (TypeError, ValueError):
+        return str(payload)
+
+
+def _chunk_text(c: dict) -> str:
+    return str(c.get("content", c.get("text", "")))
+
+
+def _chunk_hash(c: dict) -> str:
+    return hashlib.blake2b(
+        (_chunk_text(c) + "|" + str(c.get("file_path", ""))).encode(), digest_size=8
+    ).hexdigest()
+
+
+def _overlap(query: str, text: str) -> bool:
+    qtok = set(query.lower().split())
+    ttok = set(text.lower().split())
+    return len(qtok & ttok) >= max(1, len(qtok) // 4)

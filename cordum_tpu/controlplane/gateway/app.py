@@ -87,6 +87,7 @@ class Gateway:
         schemas: Optional[SchemaRegistry] = None,
         configsvc: Optional[ConfigService] = None,
         registry: Optional[WorkerRegistry] = None,
+        context_svc: Optional[Any] = None,
         auth: Optional[AuthProvider] = None,
         metrics: Optional[Metrics] = None,
         rate_rps: float = 0.0,
@@ -104,6 +105,7 @@ class Gateway:
         self.schemas = schemas or SchemaRegistry(kv)
         self.configsvc = configsvc
         self.registry = registry
+        self.context_svc = context_svc
         self.dlq = DLQStore(kv)
         self.locks = LockStore(kv)
         self.artifacts = ArtifactStore(kv)
@@ -162,6 +164,9 @@ class Gateway:
         r.add_post(f"{v1}/artifacts", self.put_artifact)
         r.add_get(f"{v1}/artifacts/{{artifact_id}}", self.get_artifact)
         r.add_get(f"{v1}/memory", self.read_pointer)
+        r.add_post(f"{v1}/context/window", self.context_window)
+        r.add_post(f"{v1}/context/memory/{{memory_id}}", self.context_update)
+        r.add_put(f"{v1}/context/chunks/{{memory_id}}", self.context_chunks)
         r.add_get(f"{v1}/traces/{{trace_id}}", self.get_trace)
         r.add_get(f"{v1}/workers", self.get_workers)
         r.add_get(f"{v1}/status", self.get_status)
@@ -760,6 +765,39 @@ class Gateway:
         if data is None:
             return _err(404, "unknown artifact")
         return web.Response(body=data, content_type=meta.content_type if meta else "application/octet-stream")
+
+    async def context_window(self, request: web.Request) -> web.Response:
+        if getattr(self, "context_svc", None) is None:
+            return _err(501, "context engine not wired")
+        body = await request.json()
+        msgs = await self.context_svc.build_window(
+            str(body.get("memory_id", "")),
+            mode=str(body.get("mode", "RAW")).upper(),
+            payload=body.get("payload"),
+            max_input_tokens=int(body.get("max_input_tokens", 4000)),
+        )
+        return web.json_response({"messages": [m.to_dict() for m in msgs]})
+
+    async def context_update(self, request: web.Request) -> web.Response:
+        if getattr(self, "context_svc", None) is None:
+            return _err(501, "context engine not wired")
+        body = await request.json()
+        await self.context_svc.update_memory(
+            request.match_info["memory_id"],
+            user_payload=body.get("payload"),
+            model_response=str(body.get("model_response", "")),
+            mode=str(body.get("mode", "CHAT")).upper(),
+        )
+        return web.json_response({"ok": True})
+
+    async def context_chunks(self, request: web.Request) -> web.Response:
+        if getattr(self, "context_svc", None) is None:
+            return _err(501, "context engine not wired")
+        body = await request.json()
+        n = await self.context_svc.put_chunks(
+            request.match_info["memory_id"], list(body.get("chunks") or [])
+        )
+        return web.json_response({"embedded": n})
 
     async def read_pointer(self, request: web.Request) -> web.Response:
         ptr = request.query.get("ptr", "")

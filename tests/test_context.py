@@ -1,0 +1,116 @@
+"""Context engine: window modes, memory updates, semantic RAG ranking,
+token budget trimming."""
+import numpy as np
+import pytest
+
+from cordum_tpu.context.service import (
+    ContextService,
+    ModelMessage,
+    estimate_tokens,
+    trim_to_budget,
+)
+from cordum_tpu.infra.kv import MemoryKV
+
+
+class FakeEmbedder:
+    """Deterministic bag-of-words embedder for tests."""
+
+    VOCAB = ["scheduler", "jobs", "tpu", "cooking", "recipe", "pasta"]
+
+    def embed(self, texts):
+        out = np.zeros((len(texts), len(self.VOCAB)), np.float32)
+        for i, t in enumerate(texts):
+            for j, w in enumerate(self.VOCAB):
+                out[i, j] = t.lower().count(w)
+            n = np.linalg.norm(out[i]) or 1.0
+            out[i] /= n
+        return out
+
+
+async def test_raw_mode(kv):
+    svc = ContextService(kv)
+    msgs = await svc.build_window("m1", mode="RAW", payload={"q": "hello"})
+    assert len(msgs) == 1 and msgs[0].source == "payload"
+
+
+async def test_chat_mode_history_window(kv):
+    svc = ContextService(kv)
+    for i in range(30):
+        await svc.update_memory("m1", user_payload=f"q{i}", model_response=f"a{i}")
+    msgs = await svc.build_window("m1", mode="CHAT", payload="latest")
+    history = [m for m in msgs if m.source == "history"]
+    assert len(history) == 20  # last-20 window
+    assert history[-1].content == "a29"
+    assert msgs[-1].content == "latest"
+
+
+async def test_rag_semantic_ranking(kv):
+    svc = ContextService(kv, embedder=FakeEmbedder(), max_chunks=2)
+    await svc.put_chunks("m1", [
+        {"file_path": "cook.md", "content": "cooking pasta recipe"},
+        {"file_path": "sched.md", "content": "the scheduler dispatches jobs to tpu"},
+        {"file_path": "other.md", "content": "unrelated things entirely"},
+    ])
+    msgs = await svc.build_window("m1", mode="RAG", payload="how does the scheduler assign jobs?")
+    rag = [m for m in msgs if m.source.startswith("rag:")]
+    assert rag and "sched.md" in rag[0].content  # semantic top hit
+
+
+async def test_rag_embedding_cache_incremental(kv):
+    emb = FakeEmbedder()
+    calls = []
+    orig = emb.embed
+
+    def counting(texts):
+        calls.append(len(texts))
+        return orig(texts)
+
+    emb.embed = counting
+    svc = ContextService(kv, embedder=emb)
+    n1 = await svc.put_chunks("m1", [{"file_path": "a", "content": "tpu jobs"}])
+    assert n1 == 1
+    n2 = await svc.put_chunks("m1", [{"file_path": "a", "content": "tpu jobs"},
+                                     {"file_path": "b", "content": "pasta"}])
+    assert n2 == 1  # only the new chunk embedded
+
+
+async def test_rag_summary_fallback(kv):
+    svc = ContextService(kv)
+    await svc.set_summary("m1", "summary of past events")
+    msgs = await svc.build_window("m1", mode="RAG", payload="q")
+    assert msgs[0].source == "summary"
+
+
+async def test_rag_lexical_fallback_without_embedder(kv):
+    svc = ContextService(kv)
+    await svc.put_chunks("m1", [
+        {"file_path": "a.md", "content": "scheduler dispatch logic"},
+        {"file_path": "b.md", "content": "zebra giraffe"},
+    ])
+    msgs = await svc.build_window("m1", mode="RAG", payload="scheduler dispatch details")
+    rag = [m for m in msgs if m.source.startswith("rag:")]
+    assert len(rag) == 1 and "a.md" in rag[0].content
+
+
+def test_token_estimate_and_trim():
+    assert estimate_tokens("abcd" * 10) == 10
+    msgs = [
+        ModelMessage(role="system", content="x" * 400, source="history"),
+        ModelMessage(role="system", content="y" * 400, source="history"),
+        ModelMessage(role="user", content="z" * 40, source="payload"),
+    ]
+    out = trim_to_budget(msgs, 120)
+    # oldest history dropped first; payload survives
+    assert [m.source for m in out] == ["history", "payload"]
+    # extreme budget truncates the payload itself
+    out2 = trim_to_budget(list(msgs), 5)
+    assert len(out2) == 1 and len(out2[0].content) <= 20
+
+
+async def test_update_memory_caps_history(kv):
+    svc = ContextService(kv)
+    for i in range(600):
+        await svc.update_memory("m1", user_payload=f"u{i}")
+    from cordum_tpu.context.service import _events_key
+
+    assert await kv.llen(_events_key("m1")) == 500

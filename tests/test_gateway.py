@@ -371,6 +371,25 @@ async def test_ws_stream_broadcast():
         await ws.close()
 
 
+async def test_context_endpoints():
+    from cordum_tpu.context.service import ContextService
+
+    async with GwStack() as s:
+        s.gw.context_svc = ContextService(s.kv)
+        r = await s.client.post("/api/v1/context/memory/m1",
+                                json={"payload": "hello", "model_response": "world"}, headers=s.h())
+        assert r.status == 200
+        r = await s.client.post("/api/v1/context/window",
+                                json={"memory_id": "m1", "mode": "CHAT", "payload": "next"},
+                                headers=s.h())
+        doc = await r.json()
+        roles = [m["content"] for m in doc["messages"]]
+        assert roles == ["hello", "world", "next"]
+        r = await s.client.put("/api/v1/context/chunks/m1",
+                               json={"chunks": [{"file_path": "a", "content": "x"}]}, headers=s.h())
+        assert r.status == 200
+
+
 async def test_job_cancel_endpoint():
     async with GwStack() as s:
         # submit to a topic with no worker so it stays RUNNING
