@@ -1,0 +1,373 @@
+"""Statebus: the framework's standalone state + message-bus server.
+
+The reference control plane outsources state to Redis and messaging to NATS
+(SURVEY §2.2).  This environment has neither client library — and a
+TPU-native deployment wants one less moving part anyway — so the framework
+ships its own: a single asyncio TCP server speaking a msgpack-framed
+protocol that provides BOTH
+
+  * the full :class:`~cordum_tpu.infra.kv.KV` surface (strings, hashes,
+    z-sets, lists, sets, TTLs, versioned optimistic ``commit``) backed by
+    the in-process :class:`MemoryKV` engine, with optional append-only-file
+    persistence (every mutating op logged; replayed on restart — the
+    "crash-safe state" guarantee), and
+  * pub/sub with NATS-style wildcard subjects and queue groups
+    (:class:`StateBusBus` delivers into local handlers with the same
+    RetryAfter redelivery semantics as the loopback bus).
+
+Wire format: ``[4-byte BE length][msgpack array]``.
+Requests:  ``[req_id, op, *args]`` → ``[req_id, "ok"|"err", result]``.
+Server pushes: ``[0, "msg", sid, subject, packet_bytes]``.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+import struct
+import time
+from typing import Any, Optional
+
+import msgpack
+
+from ..protocol.types import BusPacket
+from ..utils.globmatch import subject_match
+from . import logging as logx
+from .bus import Bus, DEDUP_WINDOW_S, MAX_REDELIVERIES, RetryAfter, Subscription, compute_msg_id
+from .kv import KV, MemoryKV
+
+_LEN = struct.Struct(">I")
+
+# KV ops forwarded verbatim to the MemoryKV engine (name → is_mutation)
+_KV_OPS = {
+    "get": False, "set": True, "setnx": True, "delete": True, "expire": True,
+    "keys": False, "hset": True, "hget": False, "hgetall": False, "hdel": True,
+    "hincrby": True, "zadd": True, "zrem": True, "zrange": False,
+    "zrangebyscore": False, "zcard": False, "zscore": False, "rpush": True,
+    "lrange": False, "ltrim": True, "llen": False, "sadd": True,
+    "smembers": False, "version": False, "commit": True, "ping": False,
+}
+
+
+def _encode(obj: Any) -> bytes:
+    b = msgpack.packb(obj, use_bin_type=True)
+    return _LEN.pack(len(b)) + b
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Optional[list]:
+    try:
+        head = await reader.readexactly(4)
+        (n,) = _LEN.unpack(head)
+        body = await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+def _plain(v: Any) -> Any:
+    """msgpack-safe: sets → sorted lists."""
+    if isinstance(v, set):
+        return sorted(v)
+    return v
+
+
+class StateBusServer:
+    """The server process: KV engine + subscription routing + AOF."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 7420, *, aof_path: str = ""):
+        self.host = host
+        self.port = port
+        self.kv = MemoryKV()
+        self.aof_path = aof_path
+        self._aof = None
+        self._server: Optional[asyncio.base_events.Server] = None
+        # sid → (writer, pattern, queue)
+        self._subs: dict[int, tuple[asyncio.StreamWriter, str, Optional[str]]] = {}
+        self._sid = itertools.count(1)
+        self._rr: dict[tuple[str, str], int] = {}
+        self._dedup: dict[str, float] = {}
+        self._writers: set[asyncio.StreamWriter] = set()
+        self._write_locks: dict[asyncio.StreamWriter, asyncio.Lock] = {}
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> None:
+        if self.aof_path:
+            await self._replay_aof()
+            self._aof = open(self.aof_path, "ab")
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        logx.info("statebus listening", host=self.host, port=self.port, aof=self.aof_path or "off")
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for w in list(self._writers):
+            w.close()
+        if self._aof:
+            self._aof.flush()
+            self._aof.close()
+            self._aof = None
+
+    async def _replay_aof(self) -> None:
+        if not os.path.exists(self.aof_path):
+            return
+        n = 0
+        with open(self.aof_path, "rb") as f:
+            unpacker = msgpack.Unpacker(f, raw=False, strict_map_key=False)
+            for entry in unpacker:
+                op, args = entry[0], entry[1:]
+                try:
+                    await getattr(self.kv, op)(*args)
+                    n += 1
+                except Exception:
+                    logx.warn("aof replay skipped bad entry", op=op)
+        logx.info("aof replayed", entries=n)
+
+    def _log_aof(self, op: str, args: tuple) -> None:
+        if self._aof is not None:
+            self._aof.write(msgpack.packb([op, *args], use_bin_type=True))
+
+    # -- connection handling -------------------------------------------
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._writers.add(writer)
+        self._write_locks[writer] = asyncio.Lock()
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                if frame is None:
+                    break
+                asyncio.ensure_future(self._dispatch(frame, writer))
+        finally:
+            self._writers.discard(writer)
+            self._write_locks.pop(writer, None)
+            dead = [sid for sid, (w, _, _) in self._subs.items() if w is writer]
+            for sid in dead:
+                del self._subs[sid]
+            writer.close()
+
+    async def _send(self, writer: asyncio.StreamWriter, obj: list) -> None:
+        lock = self._write_locks.get(writer)
+        if lock is None:
+            return
+        async with lock:
+            writer.write(_encode(obj))
+            await writer.drain()
+
+    async def _dispatch(self, frame: list, writer: asyncio.StreamWriter) -> None:
+        req_id, op, *args = frame
+        try:
+            if op in _KV_OPS:
+                result = await getattr(self.kv, op)(*args)
+                if _KV_OPS[op]:
+                    self._log_aof(op, tuple(args))
+                await self._send(writer, [req_id, "ok", _plain(result)])
+            elif op == "sub":
+                pattern, queue = args
+                sid = next(self._sid)
+                self._subs[sid] = (writer, pattern, queue or None)
+                await self._send(writer, [req_id, "ok", sid])
+            elif op == "unsub":
+                self._subs.pop(args[0], None)
+                await self._send(writer, [req_id, "ok", True])
+            elif op == "pub":
+                subject, packet_bytes = args
+                await self._route(subject, packet_bytes)
+                await self._send(writer, [req_id, "ok", True])
+            else:
+                await self._send(writer, [req_id, "err", f"unknown op {op!r}"])
+        except Exception as e:  # noqa: BLE001
+            try:
+                await self._send(writer, [req_id, "err", str(e)])
+            except Exception:
+                pass
+
+    async def _route(self, subject: str, packet_bytes: bytes) -> None:
+        from ..protocol import subjects as subj
+
+        if subj.is_durable_subject(subject):
+            try:
+                pkt = BusPacket.from_wire(packet_bytes)
+                mid = compute_msg_id(subject, pkt)
+            except Exception:
+                mid = ""
+            if mid:
+                now = time.monotonic()
+                if len(self._dedup) > 8192:
+                    self._dedup = {k: t for k, t in self._dedup.items() if now - t < DEDUP_WINDOW_S}
+                seen = self._dedup.get(mid)
+                if seen is not None and now - seen < DEDUP_WINDOW_S:
+                    return
+                self._dedup[mid] = now
+        plain: list[tuple[int, asyncio.StreamWriter]] = []
+        groups: dict[tuple[str, str], list[tuple[int, asyncio.StreamWriter]]] = {}
+        for sid, (w, pattern, queue) in self._subs.items():
+            if not subject_match(pattern, subject):
+                continue
+            if queue is None:
+                plain.append((sid, w))
+            else:
+                groups.setdefault((pattern, queue), []).append((sid, w))
+        for key, members in groups.items():
+            members.sort()
+            i = self._rr.get(key, 0)
+            plain.append(members[i % len(members)])
+            self._rr[key] = i + 1
+        for sid, w in plain:
+            try:
+                await self._send(w, [0, "msg", sid, subject, packet_bytes])
+            except Exception:
+                pass
+
+
+class StateBusConn:
+    """Shared TCP connection: request/response + push routing."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._req_id = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._handlers: dict[int, Any] = {}  # sid → async handler(subject, bytes)
+        self._reader_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+
+    async def close(self) -> None:
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("statebus connection closed"))
+        self._pending.clear()
+
+    async def _read_loop(self) -> None:
+        while True:
+            frame = await _read_frame(self._reader)
+            if frame is None:
+                break
+            if frame[0] == 0 and frame[1] == "msg":
+                _, _, sid, subject, packet_bytes = frame
+                handler = self._handlers.get(sid)
+                if handler is not None:
+                    asyncio.ensure_future(handler(subject, packet_bytes))
+                continue
+            req_id, status, result = frame
+            fut = self._pending.pop(req_id, None)
+            if fut is not None and not fut.done():
+                if status == "ok":
+                    fut.set_result(result)
+                else:
+                    fut.set_exception(RuntimeError(f"statebus: {result}"))
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("statebus connection lost"))
+        self._pending.clear()
+
+    async def call(self, op: str, *args: Any) -> Any:
+        req_id = next(self._req_id)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._lock:
+            self._writer.write(_encode([req_id, op, *args]))
+            await self._writer.drain()
+        return await fut
+
+
+def _maybe_bytes(v: Any) -> Any:
+    return v
+
+
+class StateBusKV(KV):
+    """KV interface over a statebus connection."""
+
+    def __init__(self, conn: StateBusConn):
+        self.conn = conn
+
+    async def close(self) -> None:
+        await self.conn.close()
+
+
+def _make_kv_method(op: str):
+    async def method(self, *args):
+        result = await self.conn.call(op, *args)
+        if op == "smembers" and isinstance(result, list):
+            return set(result)
+        if op == "hgetall" and isinstance(result, dict):
+            return {k if isinstance(k, str) else k.decode(): v for k, v in result.items()}
+        return result
+
+    method.__name__ = op
+    return method
+
+
+for _op in _KV_OPS:
+    if _op != "commit":
+        setattr(StateBusKV, _op, _make_kv_method(_op))
+
+
+async def _commit(self, watches: dict[str, int], ops: list[tuple]) -> bool:
+    return await self.conn.call("commit", watches, [list(o) for o in ops])
+
+
+StateBusKV.commit = _commit  # type: ignore[assignment]
+
+
+class StateBusBus(Bus):
+    """Bus interface over a statebus connection, with client-side RetryAfter
+    redelivery (at-least-once on durable subjects)."""
+
+    def __init__(self, conn: StateBusConn):
+        self.conn = conn
+
+    async def publish(self, subject: str, pkt: BusPacket) -> None:
+        await self.conn.call("pub", subject, pkt.to_wire())
+
+    async def subscribe(self, pattern: str, handler, *, queue: Optional[str] = None) -> Subscription:
+        from ..protocol import subjects as subj
+
+        async def deliver(subject: str, packet_bytes: bytes, attempt: int = 1) -> None:
+            try:
+                await handler(subject, BusPacket.from_wire(packet_bytes))
+            except RetryAfter as ra:
+                if subj.is_durable_subject(subject) and attempt < MAX_REDELIVERIES:
+                    await asyncio.sleep(ra.delay_s)
+                    await deliver(subject, packet_bytes, attempt + 1)
+                else:
+                    logx.warn("dropping message after retries", subject=subject)
+            except Exception:
+                logx.error("bus handler error", subject=subject)
+
+        sid = await self.conn.call("sub", pattern, queue or "")
+        self.conn._handlers[sid] = deliver
+
+        def _unsub() -> None:
+            self.conn._handlers.pop(sid, None)
+            asyncio.ensure_future(self.conn.call("unsub", sid))
+
+        return Subscription(_unsub)
+
+    async def ping(self) -> bool:
+        try:
+            return bool(await self.conn.call("ping"))
+        except Exception:
+            return False
+
+
+async def connect(url: str = "") -> tuple[StateBusKV, StateBusBus, StateBusConn]:
+    """Parse ``statebus://host:port`` (env CORDUM_STATEBUS_URL) and connect."""
+    url = url or os.environ.get("CORDUM_STATEBUS_URL", "statebus://127.0.0.1:7420")
+    hostport = url.split("://", 1)[-1]
+    host, _, port = hostport.partition(":")
+    conn = StateBusConn(host or "127.0.0.1", int(port or 7420))
+    await conn.connect()
+    return StateBusKV(conn), StateBusBus(conn), conn

@@ -1,0 +1,170 @@
+"""Statebus server: KV over TCP, pub/sub with queue groups, dedupe, AOF
+persistence, and a cross-connection control-plane round trip."""
+import asyncio
+import os
+
+import pytest
+
+from cordum_tpu.infra.statebus import StateBusServer, connect
+from cordum_tpu.protocol import subjects as subj
+from cordum_tpu.protocol.types import BusPacket, Heartbeat, JobRequest, JobResult
+
+
+async def start_server(**kw):
+    srv = StateBusServer(port=0, **kw)
+    await srv.start()
+    return srv
+
+
+async def test_kv_over_tcp():
+    srv = await start_server()
+    kv, bus, conn = await connect(f"statebus://127.0.0.1:{srv.port}")
+    try:
+        await kv.set("a", b"1")
+        assert await kv.get("a") == b"1"
+        assert await kv.setnx("a", b"2") is False
+        await kv.hset("h", {"x": b"1"})
+        assert await kv.hgetall("h") == {"x": b"1"}
+        await kv.zadd("z", "m1", 2.0)
+        await kv.zadd("z", "m2", 1.0)
+        assert await kv.zrange("z") == ["m2", "m1"]
+        await kv.rpush("l", b"a", b"b")
+        assert await kv.lrange("l") == [b"a", b"b"]
+        await kv.sadd("s", "x", "y")
+        assert await kv.smembers("s") == {"x", "y"}
+        ver = await kv.version("a")
+        assert await kv.commit({"a": ver}, [("set", "a", b"3")]) is True
+        assert await kv.commit({"a": ver}, [("set", "a", b"4")]) is False
+        assert await kv.get("a") == b"3"
+        assert await kv.ping()
+    finally:
+        await conn.close()
+        await srv.stop()
+
+
+async def test_pubsub_queue_groups_across_connections():
+    srv = await start_server()
+    kv1, bus1, c1 = await connect(f"statebus://127.0.0.1:{srv.port}")
+    kv2, bus2, c2 = await connect(f"statebus://127.0.0.1:{srv.port}")
+    got1, got2, fan = [], [], []
+    try:
+        async def h1(s, p):
+            got1.append(p.job_request.job_id)
+
+        async def h2(s, p):
+            got2.append(p.job_request.job_id)
+
+        async def hf(s, p):
+            fan.append(s)
+
+        await bus1.subscribe("sys.job.submit", h1, queue="g")
+        await bus2.subscribe("sys.job.submit", h2, queue="g")
+        await bus2.subscribe("sys.job.>", hf)
+        for i in range(6):
+            await bus1.publish(subj.SUBMIT, BusPacket.wrap(JobRequest(job_id=f"j{i}", topic="t")))
+        await asyncio.sleep(0.2)
+        assert len(got1) + len(got2) == 6  # queue group: each message once
+        assert got1 and got2  # round-robin reached both connections
+        assert len(fan) == 6  # plain sub fans out
+    finally:
+        await c1.close()
+        await c2.close()
+        await srv.stop()
+
+
+async def test_server_side_dedupe():
+    srv = await start_server()
+    kv, bus, conn = await connect(f"statebus://127.0.0.1:{srv.port}")
+    got = []
+    try:
+        async def h(s, p):
+            got.append(p.job_request.job_id)
+
+        await bus.subscribe("sys.job.submit", h, queue="g")
+        req = JobRequest(job_id="same", topic="t")
+        await bus.publish(subj.SUBMIT, BusPacket.wrap(req))
+        await bus.publish(subj.SUBMIT, BusPacket.wrap(req))
+        await asyncio.sleep(0.15)
+        assert got == ["same"]
+    finally:
+        await conn.close()
+        await srv.stop()
+
+
+async def test_aof_persistence(tmp_path):
+    aof = str(tmp_path / "state.aof")
+    srv = await start_server(aof_path=aof)
+    kv, bus, conn = await connect(f"statebus://127.0.0.1:{srv.port}")
+    await kv.set("persisted", b"yes")
+    await kv.hset("job:meta:j1", {"state": b"RUNNING"})
+    await kv.zadd("job:index:RUNNING", "j1", 123.0)
+    await conn.close()
+    await srv.stop()
+    assert os.path.getsize(aof) > 0
+    # crash-restart: a new server replays the log
+    srv2 = StateBusServer(port=0, aof_path=aof)
+    await srv2.start()
+    kv2, _, conn2 = await connect(f"statebus://127.0.0.1:{srv2.port}")
+    try:
+        assert await kv2.get("persisted") == b"yes"
+        assert await kv2.hgetall("job:meta:j1") == {"state": b"RUNNING"}
+        assert await kv2.zrange("job:index:RUNNING") == ["j1"]
+    finally:
+        await conn2.close()
+        await srv2.stop()
+
+
+async def test_control_plane_over_statebus():
+    """Scheduler + worker in 'separate processes' (separate connections)
+    driving a job end-to-end through the TCP statebus."""
+    from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+    from cordum_tpu.controlplane.scheduler.engine import Engine
+    from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.jobstore import JobStore
+    from cordum_tpu.infra.memstore import MemoryStore
+    from cordum_tpu.infra.registry import WorkerRegistry
+    from cordum_tpu.worker.runtime import Worker
+
+    srv = await start_server()
+    url = f"statebus://127.0.0.1:{srv.port}"
+    skv, sbus, sconn = await connect(url)   # scheduler process
+    wkv, wbus, wconn = await connect(url)   # worker process
+    gkv, gbus, gconn = await connect(url)   # gateway-role process
+    try:
+        js = JobStore(skv)
+        reg = WorkerRegistry()
+        pc = parse_pool_config({"topics": {"job.work": "p"}, "pools": {"p": {}}})
+        eng = Engine(bus=sbus, job_store=js, safety=SafetyClient(SafetyKernel(policy_doc={}).check),
+                     strategy=LeastLoadedStrategy(reg, pc), registry=reg)
+        await eng.start()
+
+        w = Worker(bus=wbus, store=MemoryStore(wkv), worker_id="w1", pool="p",
+                   topics=["job.work"], heartbeat_interval_s=999)
+
+        async def handler(ctx):
+            return {"echo": ctx.payload}
+
+        w.register("job.work", handler)
+        await w.start()
+        await asyncio.sleep(0.1)
+
+        gm = MemoryStore(gkv)
+        ptr = await gm.put_context("j1", {"hello": "tcp"})
+        await gbus.publish(subj.SUBMIT, BusPacket.wrap(
+            JobRequest(job_id="j1", topic="job.work", context_ptr=ptr)))
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if await js.get_state("j1") == "SUCCEEDED":
+                break
+        assert await js.get_state("j1") == "SUCCEEDED"
+        res = await gm.get_result("j1")
+        assert res == {"echo": {"hello": "tcp"}}
+        await w.stop()
+        await eng.stop()
+    finally:
+        await sconn.close()
+        await wconn.close()
+        await gconn.close()
+        await srv.stop()
