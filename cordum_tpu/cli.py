@@ -1,0 +1,328 @@
+"""cordumctl — the operator CLI (reference ``cmd/cordumctl``, ~2.9k LoC:
+init/dev/up/status/workflow/run/approval/dlq/pack/job).
+
+Talks HTTP to the gateway (env CORDUM_API_URL, CORDUM_API_KEY); ``up``
+spawns the full service stack as local subprocesses.
+
+Usage: ``python -m cordum_tpu.cli <command> ...``
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Optional
+
+import httpx
+
+DEFAULT_API = os.environ.get("CORDUM_API_URL", "http://127.0.0.1:8081")
+
+
+def _client() -> httpx.Client:
+    headers = {}
+    key = os.environ.get("CORDUM_API_KEY", "")
+    if key:
+        headers["X-Api-Key"] = key
+    role = os.environ.get("CORDUM_ROLE", "")
+    if role:
+        headers["X-Principal-Role"] = role
+    pid = os.environ.get("CORDUM_PRINCIPAL", "")
+    if pid:
+        headers["X-Principal-Id"] = pid
+    return httpx.Client(base_url=DEFAULT_API, headers=headers, timeout=30.0)
+
+
+def _print(obj: Any) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+def _die(msg: str, code: int = 1) -> None:
+    print(f"error: {msg}", file=sys.stderr)
+    sys.exit(code)
+
+
+def _check(r: httpx.Response) -> Any:
+    try:
+        body = r.json()
+    except ValueError:
+        body = {"raw": r.text}
+    if r.status_code >= 400:
+        _die(f"HTTP {r.status_code}: {body.get('error', body)}")
+    return body
+
+
+# ---------------------------------------------------------------- commands
+
+
+def cmd_init(args) -> None:
+    """Scaffold config files (reference `cordumctl init`)."""
+    os.makedirs("config", exist_ok=True)
+    files = {
+        "config/pools.yaml": (
+            "topics:\n  job.default: default\n  job.tpu.>: tpu\n"
+            "pools:\n  default:\n    requires: []\n"
+            "  tpu:\n    requires: [\"tpu\"]\n    min_chips: 1\n"
+        ),
+        "config/timeouts.yaml": (
+            "reconciler:\n  dispatch_timeout_seconds: 300\n"
+            "  running_timeout_seconds: 9000\n  scan_interval_seconds: 30\n"
+        ),
+        "config/safety.yaml": (
+            "default_tenant: default\n"
+            "tenants:\n  default:\n    allow_topics: [\"job.*\", \"job.>\"]\n"
+            "    deny_topics: [\"sys.*\"]\n"
+            "rules: []\n"
+        ),
+    }
+    for path, content in files.items():
+        if os.path.exists(path) and not args.force:
+            print(f"skip {path} (exists)")
+            continue
+        with open(path, "w") as f:
+            f.write(content)
+        print(f"wrote {path}")
+
+
+SERVICES = [
+    ("statebus", "cordum_tpu.cmd.statebus", {}),
+    ("safety-kernel", "cordum_tpu.cmd.safety_kernel",
+     {"CORDUM_STATEBUS_URL": "statebus://127.0.0.1:7420"}),
+    ("scheduler", "cordum_tpu.cmd.scheduler",
+     {"CORDUM_STATEBUS_URL": "statebus://127.0.0.1:7420",
+      "SAFETY_KERNEL_ADDR": "http://127.0.0.1:7430"}),
+    ("workflow-engine", "cordum_tpu.cmd.workflow_engine",
+     {"CORDUM_STATEBUS_URL": "statebus://127.0.0.1:7420"}),
+    ("gateway", "cordum_tpu.cmd.gateway",
+     {"CORDUM_STATEBUS_URL": "statebus://127.0.0.1:7420"}),
+    ("worker", "cordum_tpu.cmd.worker",
+     {"CORDUM_STATEBUS_URL": "statebus://127.0.0.1:7420",
+      "WORKER_TOPICS": "job.tpu.>,job.default", "WORKER_POOL": "tpu"}),
+]
+
+
+def cmd_up(args) -> None:
+    """Bring up the local stack as subprocesses (reference `cordumctl up`)."""
+    procs = []
+    logdir = args.logdir
+    os.makedirs(logdir, exist_ok=True)
+    selected = [s for s in SERVICES if not args.services or s[0] in args.services]
+    for name, module, env_extra in selected:
+        env = dict(os.environ)
+        env.update(env_extra)
+        log = open(os.path.join(logdir, f"{name}.log"), "ab")
+        p = subprocess.Popen([sys.executable, "-m", module], env=env, stdout=log, stderr=log)
+        procs.append((name, p))
+        print(f"started {name} (pid {p.pid})")
+        if name == "statebus":
+            time.sleep(0.5)  # listeners need the bus first
+    print(f"logs in {logdir}/; Ctrl-C to stop")
+    try:
+        while True:
+            time.sleep(1)
+            for name, p in procs:
+                if p.poll() is not None:
+                    _die(f"service {name} exited with {p.returncode} (see {logdir}/{name}.log)")
+    except KeyboardInterrupt:
+        for name, p in reversed(procs):
+            p.terminate()
+        for name, p in procs:
+            p.wait(timeout=10)
+        print("stopped")
+
+
+def cmd_status(args) -> None:
+    with _client() as c:
+        _print(_check(c.get("/api/v1/status")))
+
+
+def cmd_job(args) -> None:
+    with _client() as c:
+        if args.action == "submit":
+            payload = json.loads(args.payload) if args.payload else {}
+            body = {"topic": args.topic, "payload": payload}
+            if args.metadata:
+                body["metadata"] = json.loads(args.metadata)
+            doc = _check(c.post("/api/v1/jobs", json=body))
+            _print(doc)
+            if args.wait:
+                _wait_job(c, doc["job_id"])
+        elif args.action == "status":
+            _print(_check(c.get(f"/api/v1/jobs/{args.job_id}?events=true")))
+        elif args.action == "result":
+            _print(_check(c.get(f"/api/v1/jobs/{args.job_id}?result=true")))
+        elif args.action == "cancel":
+            _print(_check(c.post(f"/api/v1/jobs/{args.job_id}/cancel")))
+        elif args.action == "list":
+            _print(_check(c.get("/api/v1/jobs")))
+
+
+def _wait_job(c: httpx.Client, job_id: str, timeout_s: float = 120.0) -> None:
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        doc = _check(c.get(f"/api/v1/jobs/{job_id}?result=true"))
+        state = doc.get("state", "")
+        if state in ("SUCCEEDED", "FAILED", "CANCELLED", "TIMEOUT", "DENIED"):
+            _print(doc)
+            return
+        time.sleep(0.5)
+    _die(f"timed out waiting for job {job_id}")
+
+
+def cmd_workflow(args) -> None:
+    with _client() as c:
+        if args.action == "create":
+            with open(args.file) as f:
+                import yaml
+
+                doc = yaml.safe_load(f)
+            _print(_check(c.post("/api/v1/workflows", json=doc)))
+        elif args.action == "list":
+            _print(_check(c.get("/api/v1/workflows")))
+        elif args.action == "show":
+            _print(_check(c.get(f"/api/v1/workflows/{args.workflow_id}")))
+        elif args.action == "delete":
+            _print(_check(c.delete(f"/api/v1/workflows/{args.workflow_id}")))
+
+
+def cmd_run(args) -> None:
+    with _client() as c:
+        if args.action == "start":
+            body = {"input": json.loads(args.input) if args.input else None,
+                    "dry_run": args.dry_run}
+            doc = _check(c.post(f"/api/v1/workflows/{args.workflow_id}/runs", json=body))
+            _print(doc)
+            if args.wait:
+                _wait_run(c, doc["run_id"])
+        elif args.action == "status":
+            _print(_check(c.get(f"/api/v1/runs/{args.run_id}")))
+        elif args.action == "timeline":
+            _print(_check(c.get(f"/api/v1/runs/{args.run_id}/timeline")))
+        elif args.action == "cancel":
+            _print(_check(c.post(f"/api/v1/runs/{args.run_id}/cancel")))
+        elif args.action == "approve-step":
+            _print(_check(c.post(
+                f"/api/v1/runs/{args.run_id}/steps/{args.step_id}/approve",
+                json={"approve": not args.reject})))
+        elif args.action == "rerun":
+            _print(_check(c.post(f"/api/v1/runs/{args.run_id}/rerun",
+                                 json={"from_step": args.step_id})))
+        elif args.action == "list":
+            _print(_check(c.get("/api/v1/runs")))
+
+
+def _wait_run(c: httpx.Client, run_id: str, timeout_s: float = 300.0) -> None:
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        doc = _check(c.get(f"/api/v1/runs/{run_id}"))
+        if doc.get("status") in ("SUCCEEDED", "FAILED", "CANCELLED"):
+            _print(doc)
+            return
+        time.sleep(0.5)
+    _die(f"timed out waiting for run {run_id}")
+
+
+def cmd_approval(args) -> None:
+    with _client() as c:
+        if args.action == "list":
+            _print(_check(c.get("/api/v1/approvals")))
+        elif args.action == "approve":
+            _print(_check(c.post(f"/api/v1/approvals/{args.job_id}/approve")))
+        elif args.action == "reject":
+            _print(_check(c.post(f"/api/v1/approvals/{args.job_id}/reject",
+                                 json={"reason": args.reason})))
+
+
+def cmd_dlq(args) -> None:
+    with _client() as c:
+        if args.action == "list":
+            _print(_check(c.get("/api/v1/dlq")))
+        elif args.action == "retry":
+            _print(_check(c.post(f"/api/v1/dlq/{args.job_id}/retry")))
+        elif args.action == "delete":
+            _print(_check(c.delete(f"/api/v1/dlq/{args.job_id}")))
+
+
+def cmd_pack(args) -> None:
+    from .packs import cli_pack
+
+    cli_pack(args)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cordumctl", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="scaffold config files")
+    sp.add_argument("--force", action="store_true")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("up", help="start the local service stack")
+    sp.add_argument("--logdir", default=".cordum-logs")
+    sp.add_argument("services", nargs="*", help="subset of services to start")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("status", help="gateway status")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("job")
+    sp.add_argument("action", choices=["submit", "status", "result", "cancel", "list"])
+    sp.add_argument("job_id", nargs="?")
+    sp.add_argument("--topic", default="job.default")
+    sp.add_argument("--payload", default="")
+    sp.add_argument("--metadata", default="")
+    sp.add_argument("--wait", action="store_true")
+    sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("workflow")
+    sp.add_argument("action", choices=["create", "list", "show", "delete"])
+    sp.add_argument("workflow_id", nargs="?")
+    sp.add_argument("--file", "-f", default="")
+    sp.set_defaults(fn=cmd_workflow)
+
+    sp = sub.add_parser("run")
+    sp.add_argument("action", choices=["start", "status", "timeline", "cancel",
+                                       "approve-step", "rerun", "list"])
+    sp.add_argument("run_id", nargs="?")
+    sp.add_argument("--workflow-id", dest="workflow_id", default="")
+    sp.add_argument("--input", default="")
+    sp.add_argument("--step-id", dest="step_id", default="")
+    sp.add_argument("--reject", action="store_true")
+    sp.add_argument("--dry-run", dest="dry_run", action="store_true")
+    sp.add_argument("--wait", action="store_true")
+    sp.set_defaults(fn=cmd_run)
+
+    sp = sub.add_parser("approval")
+    sp.add_argument("action", choices=["list", "approve", "reject"])
+    sp.add_argument("job_id", nargs="?")
+    sp.add_argument("--reason", default="rejected")
+    sp.set_defaults(fn=cmd_approval)
+
+    sp = sub.add_parser("dlq")
+    sp.add_argument("action", choices=["list", "retry", "delete"])
+    sp.add_argument("job_id", nargs="?")
+    sp.set_defaults(fn=cmd_dlq)
+
+    sp = sub.add_parser("pack")
+    sp.add_argument("action", choices=["create", "install", "uninstall", "list", "show", "verify"])
+    sp.add_argument("target", nargs="?")
+    sp.add_argument("--dir", default=".")
+    sp.set_defaults(fn=cmd_pack)
+
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    # `run start` takes the workflow id positionally when --workflow-id absent
+    if getattr(args, "command", "") == "run" and args.action == "start" and not args.workflow_id:
+        args.workflow_id = args.run_id or ""
+        if not args.workflow_id:
+            _die("run start requires a workflow id")
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Shared bootstrap for service binaries: env config, logging, statebus
+connection, signal-driven shutdown (reference ``cmd/*`` thin mains)."""
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+
+from ..infra import logging as logx
+from ..infra.config import Config, load
+
+
+def setup() -> Config:
+    logx.setup()
+    return load()
+
+
+async def connect_statebus(cfg: Config):
+    from ..infra import statebus
+
+    url = cfg.statebus_url or "statebus://127.0.0.1:7420"
+    kv, bus, conn = await statebus.connect(url)
+    logx.info("connected to statebus", url=url)
+    return kv, bus, conn
+
+
+async def wait_for_shutdown() -> None:
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover
+            pass
+    await stop.wait()
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default

@@ -1,0 +1,55 @@
+"""API-gateway service binary (reference ``cmd/cordum-api-gateway``).
+
+Runs the HTTP/WS surface against the statebus; the workflow engine is
+embedded (the gateway is a second consumer of results in the reference too,
+gateway.go:610-651), the safety kernel is embedded or remote."""
+from __future__ import annotations
+
+import asyncio
+import os
+
+from ..context.service import ContextService
+from ..controlplane.gateway.app import Gateway
+from ..controlplane.gateway.auth import BasicAuthProvider
+from ..controlplane.safetykernel.kernel import SafetyKernel
+from ..infra.configsvc import ConfigService
+from ..infra.jobstore import JobStore
+from ..infra.memstore import MemoryStore
+from ..infra.registry import WorkerRegistry
+from ..infra.schemareg import SchemaRegistry
+from ..workflow.engine import Engine as WorkflowEngine
+from ..workflow.store import WorkflowStore
+from . import _boot
+
+
+async def main() -> None:
+    cfg = _boot.setup()
+    kv, bus, conn = await _boot.connect_statebus(cfg)
+    configsvc = ConfigService(kv)
+    kernel = SafetyKernel(policy_path=cfg.safety_policy_path, configsvc=configsvc)
+    await kernel.reload()
+    schemas = SchemaRegistry(kv)
+    mem = MemoryStore(kv)
+    wf_store = WorkflowStore(kv)
+    wf_engine = WorkflowEngine(store=wf_store, bus=bus, mem=mem, schemas=schemas,
+                               configsvc=configsvc, instance_id="gateway-wf")
+    admin_keys = [k for k in os.environ.get("CORDUM_ADMIN_KEYS", "").split(",") if k]
+    gw = Gateway(
+        kv=kv, bus=bus, job_store=JobStore(kv), mem=mem, kernel=kernel,
+        wf_store=wf_store, wf_engine=wf_engine, schemas=schemas, configsvc=configsvc,
+        registry=WorkerRegistry(), context_svc=ContextService(kv),
+        auth=BasicAuthProvider(cfg.api_keys, admin_keys=admin_keys),
+        rate_rps=_boot.env_float("API_RATE_LIMIT_RPS", 0.0),
+        max_concurrent_runs=_boot.env_int("MAX_CONCURRENT_RUNS", 0),
+    )
+    host, _, port = cfg.gateway_http_addr.partition(":")
+    await gw.start(host or "127.0.0.1", int(port or 8081))
+    try:
+        await _boot.wait_for_shutdown()
+    finally:
+        await gw.stop()
+        await conn.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,89 @@
+"""Scheduler service binary (reference ``cmd/cordum-scheduler/main.go:24-179``):
+statebus connection → job store → safety client (remote kernel or embedded)
+→ pool config + overlay bootstrap/watch → engine + reconciler + pending
+replayer + worker-snapshot writer → shutdown on signal."""
+from __future__ import annotations
+
+import asyncio
+import os
+
+import yaml
+
+from ..controlplane.safetykernel.kernel import SafetyKernel
+from ..controlplane.safetykernel.service import remote_check
+from ..controlplane.scheduler.engine import Engine
+from ..controlplane.scheduler.overlay import ConfigOverlay, WorkerSnapshotWriter
+from ..controlplane.scheduler.reconciler import PendingReplayer, Reconciler
+from ..controlplane.scheduler.safety_client import SafetyClient
+from ..controlplane.scheduler.strategy import LeastLoadedStrategy
+from ..infra import logging as logx
+from ..infra.configsvc import ConfigService
+from ..infra.jobstore import JobStore
+from ..infra.registry import WorkerRegistry
+from ..infra.config import load_pool_config, load_timeouts
+from . import _boot
+
+
+async def main() -> None:
+    cfg = _boot.setup()
+    kv, bus, conn = await _boot.connect_statebus(cfg)
+    job_store = JobStore(kv)
+    configsvc = ConfigService(kv)
+    registry = WorkerRegistry()
+
+    pool_cfg = load_pool_config(cfg.pool_config_path)
+    timeouts = load_timeouts(cfg.timeout_config_path)
+    strategy = LeastLoadedStrategy(registry, pool_cfg)
+
+    kernel_addr = cfg.safety_kernel_addr
+    if kernel_addr:
+        check_fn = remote_check(kernel_addr)
+    else:  # embedded kernel (single-binary deployments)
+        kernel = SafetyKernel(policy_path=cfg.safety_policy_path, configsvc=configsvc)
+        await kernel.reload()
+        check_fn = kernel.check
+    safety = SafetyClient(check_fn)
+
+    engine = Engine(
+        bus=bus, job_store=job_store, safety=safety, strategy=strategy,
+        registry=registry, configsvc=configsvc,
+        instance_id=os.environ.get("SCHEDULER_ID", "scheduler-0"),
+        tenant_concurrency_limit=_boot.env_int("TENANT_CONCURRENCY_LIMIT", 0),
+    )
+    reconciler = Reconciler(job_store, timeouts, instance_id=engine.instance_id)
+    replayer = PendingReplayer(engine, job_store, timeouts)
+    overlay = ConfigOverlay(
+        configsvc, strategy, reconciler,
+        interval_s=_boot.env_float("SCHEDULER_CONFIG_RELOAD_INTERVAL", 30.0),
+    )
+    snapshotter = WorkerSnapshotWriter(kv, registry)
+
+    pools_doc = {}
+    timeouts_doc = {}
+    if os.path.exists(cfg.pool_config_path):
+        with open(cfg.pool_config_path) as f:
+            pools_doc = yaml.safe_load(f) or {}
+    if os.path.exists(cfg.timeout_config_path):
+        with open(cfg.timeout_config_path) as f:
+            timeouts_doc = yaml.safe_load(f) or {}
+    await overlay.bootstrap(pools_doc, timeouts_doc)
+
+    await engine.start()
+    await reconciler.start()
+    await replayer.start()
+    await overlay.start()
+    await snapshotter.start()
+    logx.info("scheduler running", instance=engine.instance_id)
+    try:
+        await _boot.wait_for_shutdown()
+    finally:
+        await snapshotter.stop()
+        await overlay.stop()
+        await replayer.stop()
+        await reconciler.stop()
+        await engine.stop()
+        await conn.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,25 @@
+"""Statebus server binary: ``python -m cordum_tpu.cmd.statebus``."""
+from __future__ import annotations
+
+import asyncio
+import os
+
+from ..infra.statebus import StateBusServer
+from . import _boot
+
+
+async def main() -> None:
+    _boot.setup()
+    host = os.environ.get("STATEBUS_HOST", "127.0.0.1")
+    port = _boot.env_int("STATEBUS_PORT", 7420)
+    aof = os.environ.get("STATEBUS_AOF", "")
+    srv = StateBusServer(host, port, aof_path=aof)
+    await srv.start()
+    try:
+        await _boot.wait_for_shutdown()
+    finally:
+        await srv.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

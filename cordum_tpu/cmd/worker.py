@@ -1,0 +1,52 @@
+"""TPU worker binary: the in-tree worker that owns a slice and executes jobs
+as JAX computations (the north star's ``sdk/runtime`` TPU worker).
+
+Env: WORKER_ID, WORKER_POOL, WORKER_TOPICS (comma), WORKER_CAPABILITIES,
+WORKER_MAX_PARALLEL, WORKER_TP (tensor-parallel width for the local mesh).
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+
+if os.environ.get("CORDUM_FORCE_CPU") == "1":
+    # neutralize the axon sitecustomize platform override BEFORE any jax
+    # backend initializes (the TPU grant is exclusive; CI/smoke runs must
+    # not claim it)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from ..infra.memstore import MemoryStore
+from ..worker.handlers import attach_default_tpu_worker
+from ..worker.runtime import Worker
+from . import _boot
+
+
+async def main() -> None:
+    cfg = _boot.setup()
+    kv, bus, conn = await _boot.connect_statebus(cfg)
+    env = os.environ
+    worker = Worker(
+        bus=bus,
+        store=MemoryStore(kv),
+        worker_id=env.get("WORKER_ID", f"tpu-worker-{os.getpid()}"),
+        pool=env.get("WORKER_POOL", "tpu-default"),
+        topics=[t for t in env.get("WORKER_TOPICS", "job.tpu.>").split(",") if t],
+        capabilities=[c for c in env.get("WORKER_CAPABILITIES", "tpu,echo").split(",") if c],
+        max_parallel_jobs=_boot.env_int("WORKER_MAX_PARALLEL", 4),
+        heartbeat_interval_s=_boot.env_float("WORKER_HEARTBEAT_INTERVAL", 10.0),
+        region=env.get("WORKER_REGION", ""),
+    )
+    attach_default_tpu_worker(worker, tp=_boot.env_int("WORKER_TP", 1))
+    await worker.start()
+    try:
+        await _boot.wait_for_shutdown()
+    finally:
+        await worker.stop()
+        await conn.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

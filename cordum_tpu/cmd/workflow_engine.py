@@ -1,0 +1,39 @@
+"""Workflow-engine service binary (reference ``cmd/cordum-workflow-engine``)."""
+from __future__ import annotations
+
+import asyncio
+import os
+
+from ..controlplane.workflowengine.service import WorkflowEngineService
+from ..infra.configsvc import ConfigService
+from ..infra.jobstore import JobStore
+from ..infra.memstore import MemoryStore
+from ..infra.schemareg import SchemaRegistry
+from ..workflow.engine import Engine as WorkflowEngine
+from ..workflow.store import WorkflowStore
+from . import _boot
+
+
+async def main() -> None:
+    cfg = _boot.setup()
+    kv, bus, conn = await _boot.connect_statebus(cfg)
+    engine = WorkflowEngine(
+        store=WorkflowStore(kv), bus=bus, mem=MemoryStore(kv),
+        schemas=SchemaRegistry(kv), configsvc=ConfigService(kv),
+        instance_id=os.environ.get("WF_ENGINE_ID", "wf-engine-0"),
+    )
+    svc = WorkflowEngineService(
+        engine=engine, bus=bus, job_store=JobStore(kv),
+        instance_id=os.environ.get("WF_ENGINE_ID", "wf-engine-0"),
+        reconcile_interval_s=_boot.env_float("WF_RECONCILE_INTERVAL", 5.0),
+    )
+    await svc.start()
+    try:
+        await _boot.wait_for_shutdown()
+    finally:
+        await svc.stop()
+        await conn.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

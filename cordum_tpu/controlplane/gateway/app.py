@@ -151,6 +151,10 @@ class Gateway:
         r.add_post(f"{v1}/policy/simulate", self.policy_simulate)
         r.add_post(f"{v1}/policy/explain", self.policy_explain)
         r.add_get(f"{v1}/policy/snapshots", self.policy_snapshots)
+        r.add_post(f"{v1}/packs", self.install_pack)
+        r.add_get(f"{v1}/packs", self.list_packs)
+        r.add_get(f"{v1}/packs/{{pack_id}}", self.show_pack)
+        r.add_delete(f"{v1}/packs/{{pack_id}}", self.uninstall_pack)
         r.add_get(f"{v1}/config/effective", self.config_effective)
         r.add_get(f"{v1}/config/{{scope}}/{{doc_id:.+}}", self.config_get)
         r.add_put(f"{v1}/config/{{scope}}/{{doc_id:.+}}", self.config_set)
@@ -667,6 +671,50 @@ class Gateway:
     async def policy_snapshots(self, request: web.Request) -> web.Response:
         return web.json_response({"snapshots": self.kernel.list_snapshots(),
                                   "current": self.kernel.snapshot_id})
+
+    # ------------------------------------------------------------------
+    # packs (reference gateway packs.go installer endpoints)
+    # ------------------------------------------------------------------
+    def _pack_installer(self):
+        from ...packs import PackInstaller
+
+        if self.configsvc is None:
+            raise web.HTTPNotImplemented(reason="config service not wired")
+        return PackInstaller(
+            configsvc=self.configsvc, schemas=self.schemas,
+            wf_store=self.wf_store, kernel=self.kernel,
+        )
+
+    async def install_pack(self, request: web.Request) -> web.Response:
+        from ...packs import PackError, manifest_from_doc
+
+        principal: Principal = request["principal"]
+        if principal.role != "admin":
+            return _err(403, "pack installs require the admin role")
+        try:
+            m = manifest_from_doc(await request.json())
+            record = await self._pack_installer().install(m)
+        except PackError as e:
+            return _err(400, str(e))
+        return web.json_response(record, status=201)
+
+    async def list_packs(self, request: web.Request) -> web.Response:
+        installed = await self._pack_installer().list_installed()
+        return web.json_response({"packs": installed})
+
+    async def show_pack(self, request: web.Request) -> web.Response:
+        installed = await self._pack_installer().list_installed()
+        rec = installed.get(request.match_info["pack_id"])
+        if rec is None:
+            return _err(404, "pack not installed")
+        return web.json_response(rec)
+
+    async def uninstall_pack(self, request: web.Request) -> web.Response:
+        principal: Principal = request["principal"]
+        if principal.role != "admin":
+            return _err(403, "pack uninstalls require the admin role")
+        ok = await self._pack_installer().uninstall(request.match_info["pack_id"])
+        return web.json_response({"uninstalled": ok}, status=200 if ok else 404)
 
     # ------------------------------------------------------------------
     # config / schemas / locks / artifacts / memory / traces

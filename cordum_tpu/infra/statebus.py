@@ -235,19 +235,22 @@ class StateBusConn:
         self._handlers: dict[int, Any] = {}  # sid → async handler(subject, bytes)
         self._reader_task: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
+        self._closed = False
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
     async def close(self) -> None:
+        self._closed = True
         if self._reader_task:
             self._reader_task.cancel()
         if self._writer:
             self._writer.close()
+        # deliberate close: resolve pending calls quietly (no orphan-task spam)
         for fut in self._pending.values():
             if not fut.done():
-                fut.set_exception(ConnectionError("statebus connection closed"))
+                fut.set_result(None)
         self._pending.clear()
 
     async def _read_loop(self) -> None:
@@ -274,6 +277,8 @@ class StateBusConn:
         self._pending.clear()
 
     async def call(self, op: str, *args: Any) -> Any:
+        if self._closed:
+            raise ConnectionError("statebus connection closed")
         req_id = next(self._req_id)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
@@ -298,7 +303,17 @@ class StateBusKV(KV):
 
 
 def _make_kv_method(op: str):
-    async def method(self, *args):
+    import inspect
+
+    sig = inspect.signature(getattr(MemoryKV, op))
+
+    async def method(self, *args, **kwargs):
+        if kwargs:  # server applies ops positionally: bind kwargs through
+            bound = sig.bind(self, *args, **kwargs)
+            bound.apply_defaults()
+            args = bound.args[1:]
+            if bound.kwargs:
+                args = (*args, *bound.kwargs.values())
         result = await self.conn.call(op, *args)
         if op == "smembers" and isinstance(result, list):
             return set(result)

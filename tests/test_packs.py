@@ -1,0 +1,120 @@
+"""Pack system: manifest loading, install/verify/rollback/uninstall, the
+example packs, and the HTTP install path (demo-guardrails acceptance)."""
+import pytest
+
+from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+from cordum_tpu.infra.configsvc import ConfigService
+from cordum_tpu.infra.kv import MemoryKV
+from cordum_tpu.infra.schemareg import SchemaRegistry
+from cordum_tpu.packs import (
+    PackError,
+    PackInstaller,
+    load_pack_dir,
+    manifest_from_doc,
+)
+from cordum_tpu.protocol.types import JobMetadata, PolicyCheckRequest
+from cordum_tpu.workflow.store import WorkflowStore
+
+REPO = "/root/repo"
+
+
+def make_installer(kv):
+    cs = ConfigService(kv)
+    kernel = SafetyKernel(policy_doc={"tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}},
+                          configsvc=cs)
+    return PackInstaller(
+        configsvc=cs, schemas=SchemaRegistry(kv), wf_store=WorkflowStore(kv), kernel=kernel
+    ), cs, kernel
+
+
+def test_load_example_packs():
+    hello = load_pack_dir(f"{REPO}/examples/hello-pack")
+    assert hello.id == "hello-pack" and len(hello.workflows) == 1
+    guard = load_pack_dir(f"{REPO}/examples/demo-guardrails")
+    assert guard.id == "demo-guardrails"
+    assert len(guard.policy_overlays) == 1
+    assert len(guard.simulations) == 3
+
+
+async def test_install_demo_guardrails(kv):
+    installer, cs, kernel = make_installer(kv)
+    await kernel.reload()
+    m = load_pack_dir(f"{REPO}/examples/demo-guardrails")
+    record = await installer.install(m)
+    assert "guarded-inference" in record["workflows"]
+    # policy fragment live: destructive denied, tpu constrained
+    resp = await kernel.evaluate_raw(PolicyCheckRequest(
+        topic="job.x", metadata=JobMetadata(risk_tags=["destructive"])))
+    assert resp.decision == "DENY"
+    assert resp.remediations and resp.remediations[0].id == "strip-destructive"
+    resp = await kernel.evaluate_raw(PolicyCheckRequest(
+        topic="job.tpu.infer", metadata=JobMetadata(capability="tpu")))
+    assert resp.decision == "ALLOW_WITH_CONSTRAINTS"
+    assert resp.constraints.max_chips == 4
+    # config overlay applied
+    eff = await cs.effective()
+    assert eff["rate_limits"]["concurrent_jobs"] == 8
+    # registry records it
+    assert "demo-guardrails" in await installer.list_installed()
+
+
+async def test_simulation_failure_rolls_back(kv):
+    installer, cs, kernel = make_installer(kv)
+    await kernel.reload()
+    doc = {
+        "id": "badpack", "version": "1.0",
+        "resources": {"workflows": [
+            {"id": "bp-wf", "steps": {"s": {"topic": "job.t"}}}]},
+        "overlays": {"policy": [{"id": "p", "fragment": {
+            "enabled": True,
+            "rules": [{"id": "r", "match": {"topics": ["job.z"]}, "decision": "allow"}]}}]},
+        "simulations": [{"name": "must-deny", "request": {"topic": "job.z"}, "expect": "DENY"}],
+    }
+    with pytest.raises(PackError, match="must-deny"):
+        await installer.install(manifest_from_doc(doc))
+    # everything rolled back
+    assert await installer.wf_store.get_workflow("bp-wf") is None
+    assert await cs.get("system", "policy/badpack/p") is None
+    assert "badpack" not in await installer.list_installed()
+
+
+async def test_uninstall_removes_resources(kv):
+    installer, cs, kernel = make_installer(kv)
+    await kernel.reload()
+    m = load_pack_dir(f"{REPO}/examples/demo-guardrails")
+    await installer.install(m)
+    assert await installer.uninstall("demo-guardrails")
+    assert await installer.wf_store.get_workflow("guarded-inference") is None
+    resp = await kernel.evaluate_raw(PolicyCheckRequest(
+        topic="job.x", metadata=JobMetadata(risk_tags=["destructive"])))
+    assert resp.decision == "ALLOW"  # fragment gone
+    assert not await installer.uninstall("demo-guardrails")  # idempotent
+
+
+async def test_install_invalid_workflow_rejected(kv):
+    installer, cs, kernel = make_installer(kv)
+    doc = {"id": "p1", "resources": {"workflows": [
+        {"id": "w", "steps": {"a": {"topic": "t", "depends_on": ["missing"]}}}]}}
+    with pytest.raises(PackError, match="unknown dependency"):
+        await installer.install(manifest_from_doc(doc))
+
+
+async def test_pack_http_endpoints():
+    from tests.test_gateway import GwStack
+
+    async with GwStack() as s:
+        m = load_pack_dir(f"{REPO}/examples/hello-pack")
+        doc = {"id": m.id, "version": m.version,
+               "resources": {"workflows": m.workflows, "schemas": m.schemas},
+               "overlays": {"config": m.config_overlays, "policy": m.policy_overlays},
+               "simulations": m.simulations}
+        r = await s.client.post("/api/v1/packs", json=doc, headers=s.h())
+        assert r.status == 403  # non-admin
+        r = await s.client.post("/api/v1/packs", json=doc, headers=s.h(admin=True))
+        assert r.status == 201
+        r = await s.client.get("/api/v1/packs", headers=s.h())
+        assert "hello-pack" in (await r.json())["packs"]
+        r = await s.client.get("/api/v1/workflows/hello-pack-echo", headers=s.h())
+        assert r.status == 200
+        r = await s.client.delete("/api/v1/packs/hello-pack", headers=s.h(admin=True))
+        assert (await r.json())["uninstalled"]

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Platform smoke: the end-to-end acceptance flow against the REAL
+multi-process stack (reference ``tools/scripts/platform_smoke.sh`` +
+``demo_guardrails.sh``).
+
+Spawns statebus, safety kernel, scheduler, workflow engine, gateway, and a
+TPU worker as separate OS processes, then over plain HTTP:
+
+  1. workflow create → run → succeeded (hello echo through the worker)
+  2. install demo-guardrails pack (admin)
+  3. destructive job → DENIED (+ DLQ entry + remediation available)
+  4. full-slice (chips:8) job → APPROVAL_REQUIRED → approve → dispatched
+  5. approval-only workflow → approve step → run succeeded
+
+Exit 0 = PASS.  Usage: python tools/platform_smoke.py [--keep]
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import httpx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATEBUS_PORT = int(os.environ.get("SMOKE_STATEBUS_PORT", "7421"))
+KERNEL_PORT = int(os.environ.get("SMOKE_KERNEL_PORT", "7431"))
+GATEWAY_PORT = int(os.environ.get("SMOKE_GATEWAY_PORT", "8082"))
+API = f"http://127.0.0.1:{GATEWAY_PORT}"
+H_USER = {"X-Api-Key": "smoke-key"}
+H_ADMIN = {"X-Api-Key": "smoke-admin", "X-Principal-Id": "smoke-admin"}
+
+
+def log(msg: str) -> None:
+    print(f"[smoke] {msg}", flush=True)
+
+
+def spawn_stack(logdir: str) -> list[subprocess.Popen]:
+    base_env = dict(os.environ)
+    base_env.update({
+        "CORDUM_STATEBUS_URL": f"statebus://127.0.0.1:{STATEBUS_PORT}",
+        "PYTHONPATH": REPO + os.pathsep + base_env.get("PYTHONPATH", ""),
+        "CORDUM_FORCE_CPU": "1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    services = [
+        ("statebus", "cordum_tpu.cmd.statebus",
+         {"STATEBUS_PORT": str(STATEBUS_PORT),
+          "STATEBUS_AOF": os.path.join(logdir, "state.aof")}),
+        ("kernel", "cordum_tpu.cmd.safety_kernel",
+         {"SAFETY_KERNEL_PORT": str(KERNEL_PORT),
+          "SAFETY_POLICY_PATH": os.path.join(logdir, "safety.yaml")}),
+        ("scheduler", "cordum_tpu.cmd.scheduler",
+         {"SAFETY_KERNEL_ADDR": f"http://127.0.0.1:{KERNEL_PORT}",
+          "POOL_CONFIG_PATH": os.path.join(logdir, "pools.yaml"),
+          "TIMEOUT_CONFIG_PATH": os.path.join(logdir, "timeouts.yaml")}),
+        ("wfengine", "cordum_tpu.cmd.workflow_engine", {}),
+        ("gateway", "cordum_tpu.cmd.gateway",
+         {"GATEWAY_HTTP_ADDR": f"127.0.0.1:{GATEWAY_PORT}",
+          "CORDUM_API_KEYS": "smoke-key",
+          "CORDUM_ADMIN_KEYS": "smoke-admin",
+          "SAFETY_POLICY_PATH": os.path.join(logdir, "safety.yaml")}),
+        ("worker", "cordum_tpu.cmd.worker",
+         {"WORKER_ID": "smoke-w1", "WORKER_POOL": "tpu",
+          "WORKER_TOPICS": "job.tpu.>,job.default,job.hello-pack.echo",
+          "WORKER_CAPABILITIES": "tpu,echo",
+          "WORKER_HEARTBEAT_INTERVAL": "1"}),
+    ]
+    # config files used by scheduler + kernel
+    with open(os.path.join(logdir, "pools.yaml"), "w") as f:
+        f.write(
+            "topics:\n  job.default: tpu\n  job.hello-pack.echo: tpu\n  job.tpu.>: tpu\n"
+            "pools:\n  tpu:\n    requires: []\n"
+        )
+    with open(os.path.join(logdir, "timeouts.yaml"), "w") as f:
+        f.write("reconciler:\n  dispatch_timeout_seconds: 60\n"
+                "  running_timeout_seconds: 120\n  scan_interval_seconds: 2\n")
+    with open(os.path.join(logdir, "safety.yaml"), "w") as f:
+        f.write("default_tenant: default\ntenants:\n  default:\n"
+                "    allow_topics: [\"job.*\", \"job.>\"]\nrules: []\n")
+    procs = []
+    for name, module, extra in services:
+        env = dict(base_env)
+        env.update(extra)
+        logf = open(os.path.join(logdir, f"{name}.log"), "ab")
+        p = subprocess.Popen([sys.executable, "-m", module], env=env,
+                             stdout=logf, stderr=logf, cwd=REPO)
+        procs.append(p)
+        log(f"started {name} (pid {p.pid})")
+        if name == "statebus":
+            time.sleep(0.8)
+    return procs
+
+
+def wait_http(url: str, timeout_s: float = 60.0) -> None:
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        try:
+            r = httpx.get(url, timeout=2.0)
+            if r.status_code < 500:
+                return
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise RuntimeError(f"timed out waiting for {url}")
+
+
+def wait_job(c: httpx.Client, job_id: str, want: str, timeout_s: float = 60.0) -> dict:
+    t0 = time.time()
+    doc = {}
+    while time.time() - t0 < timeout_s:
+        doc = c.get(f"/api/v1/jobs/{job_id}?result=true").json()
+        if doc.get("state") == want:
+            return doc
+        if doc.get("state") in ("FAILED", "DENIED", "TIMEOUT", "CANCELLED") and doc.get("state") != want:
+            raise RuntimeError(f"job {job_id} reached {doc.get('state')}, wanted {want}: {doc}")
+        time.sleep(0.4)
+    raise RuntimeError(f"job {job_id} stuck (last: {doc.get('state')}), wanted {want}")
+
+
+def wait_run(c: httpx.Client, run_id: str, want: str, timeout_s: float = 90.0) -> dict:
+    t0 = time.time()
+    doc = {}
+    while time.time() - t0 < timeout_s:
+        doc = c.get(f"/api/v1/runs/{run_id}").json()
+        if doc.get("status") == want:
+            return doc
+        if doc.get("status") in ("FAILED", "CANCELLED") and doc.get("status") != want:
+            raise RuntimeError(f"run {run_id} reached {doc['status']}, wanted {want}: {doc.get('error')}")
+        time.sleep(0.4)
+    raise RuntimeError(f"run {run_id} stuck at {doc.get('status')}, wanted {want}")
+
+
+def main() -> int:
+    keep = "--keep" in sys.argv
+    logdir = tempfile.mkdtemp(prefix="cordum-smoke-")
+    log(f"logs: {logdir}")
+    procs = spawn_stack(logdir)
+    try:
+        wait_http(f"{API}/healthz")
+        log("gateway is up")
+        with httpx.Client(base_url=API, headers=H_USER, timeout=30.0) as c, \
+             httpx.Client(base_url=API, headers=H_ADMIN, timeout=30.0) as admin:
+            # worker registered?
+            t0 = time.time()
+            while time.time() - t0 < 60:
+                workers = c.get("/api/v1/workers").json().get("workers", {})
+                if "smoke-w1" in workers:
+                    break
+                time.sleep(0.5)
+            assert "smoke-w1" in workers, f"worker never registered: {workers}"
+            log("worker registered with heartbeats")
+
+            # 1. hello workflow end-to-end through the real worker
+            wf = {"id": "smoke-hello", "name": "hello",
+                  "steps": {"echo": {"topic": "job.hello-pack.echo",
+                                     "input": {"op": "echo", "message": "hi ${input.name}"}}}}
+            r = c.post("/api/v1/workflows", json=wf)
+            assert r.status_code == 201, r.text
+            r = c.post("/api/v1/workflows/smoke-hello/runs", json={"input": {"name": "smoke"}})
+            run_id = r.json()["run_id"]
+            doc = wait_run(c, run_id, "SUCCEEDED")
+            echoed = doc["context"]["steps"]["echo"]
+            assert "hi smoke" in json.dumps(echoed), echoed
+            log(f"1. hello workflow SUCCEEDED (run {run_id[:8]})")
+
+            # 2. install demo-guardrails
+            sys.path.insert(0, REPO)
+            from cordum_tpu.packs import load_pack_dir
+
+            m = load_pack_dir(os.path.join(REPO, "examples/demo-guardrails"))
+            doc = {"id": m.id, "version": m.version,
+                   "resources": {"workflows": m.workflows, "schemas": m.schemas},
+                   "overlays": {"config": m.config_overlays, "policy": m.policy_overlays},
+                   "simulations": m.simulations}
+            r = admin.post("/api/v1/packs", json=doc)
+            assert r.status_code == 201, r.text
+            log("2. demo-guardrails pack installed (simulations passed)")
+
+            # 3. destructive job denied (kernel hot-reloads fragments ≤2s)
+            deadline = time.time() + 30
+            while True:
+                r = c.post("/api/v1/jobs", json={
+                    "topic": "job.default", "payload": {"op": "echo"},
+                    "metadata": {"risk_tags": ["destructive"]}})
+                jid = r.json()["job_id"]
+                time.sleep(1.0)
+                state = c.get(f"/api/v1/jobs/{jid}").json().get("state")
+                if state == "DENIED":
+                    break
+                if time.time() > deadline:
+                    raise RuntimeError(f"destructive job not denied (state={state})")
+                time.sleep(1.0)
+            dlq = c.get("/api/v1/dlq").json()
+            assert any(e["job_id"] == jid for e in dlq["entries"]), dlq
+            log("3. destructive job DENIED + dead-lettered")
+
+            # 4. full-slice job → approval → approve → dispatched
+            r = c.post("/api/v1/jobs", json={
+                "topic": "job.tpu.ops", "payload": {"op": "echo"},
+                "metadata": {"capability": "tpu", "requires": ["tpu", "chips:8"]}})
+            jid = r.json()["job_id"]
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                state = c.get(f"/api/v1/jobs/{jid}").json().get("state")
+                if state == "APPROVAL_REQUIRED":
+                    break
+                time.sleep(0.4)
+            assert state == "APPROVAL_REQUIRED", state
+            approvals = c.get("/api/v1/approvals").json()["approvals"]
+            assert any(a["job_id"] == jid for a in approvals)
+            r = admin.post(f"/api/v1/approvals/{jid}/approve")
+            assert r.status_code == 200, r.text
+            doc = wait_job(c, jid, "SUCCEEDED")
+            log("4. full-slice job approved and executed "
+                f"(worker={doc.get('worker_id')})")
+
+            # 5. approval workflow (guarded-inference from the pack)
+            r = c.post("/api/v1/workflows/guarded-inference/runs",
+                       json={"input": {"tokens": [[1, 2, 3]]}})
+            run_id = r.json()["run_id"]
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                st = c.get(f"/api/v1/runs/{run_id}").json()["status"]
+                if st == "WAITING_APPROVAL":
+                    break
+                time.sleep(0.4)
+            assert st == "WAITING_APPROVAL", st
+            r = admin.post(f"/api/v1/runs/{run_id}/steps/gate/approve", json={"approve": True})
+            assert r.status_code == 200, r.text
+            wait_run(c, run_id, "SUCCEEDED")
+            log("5. guarded-inference run approved → SUCCEEDED")
+
+        log("PASS")
+        return 0
+    finally:
+        for p in reversed(procs):
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if not keep:
+            log(f"logs kept at {logdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
