@@ -151,6 +151,17 @@ class Gateway:
         r.add_post(f"{v1}/policy/simulate", self.policy_simulate)
         r.add_post(f"{v1}/policy/explain", self.policy_explain)
         r.add_get(f"{v1}/policy/snapshots", self.policy_snapshots)
+        r.add_get(f"{v1}/policy/bundles", self.bundles_list)
+        r.add_get(f"{v1}/policy/bundles/{{bundle_id}}", self.bundles_get)
+        r.add_put(f"{v1}/policy/bundles/{{bundle_id}}", self.bundles_put)
+        r.add_delete(f"{v1}/policy/bundles/{{bundle_id}}", self.bundles_delete)
+        r.add_post(f"{v1}/policy/bundles/{{bundle_id}}/publish", self.bundles_publish)
+        r.add_post(f"{v1}/policy/bundles/{{bundle_id}}/unpublish", self.bundles_unpublish)
+        r.add_post(f"{v1}/policy/bundles/{{bundle_id}}/simulate", self.bundles_simulate)
+        r.add_post(f"{v1}/policy/snapshots/capture", self.snapshots_capture)
+        r.add_get(f"{v1}/policy/snapshots/captured", self.snapshots_captured)
+        r.add_post(f"{v1}/policy/snapshots/{{snapshot_id}}/rollback", self.snapshots_rollback)
+        r.add_get(f"{v1}/policy/audit", self.policy_audit)
         r.add_post(f"{v1}/packs", self.install_pack)
         r.add_get(f"{v1}/packs", self.list_packs)
         r.add_get(f"{v1}/packs/{{pack_id}}", self.show_pack)
@@ -671,6 +682,108 @@ class Gateway:
     async def policy_snapshots(self, request: web.Request) -> web.Response:
         return web.json_response({"snapshots": self.kernel.list_snapshots(),
                                   "current": self.kernel.snapshot_id})
+
+    # ------------------------------------------------------------------
+    # policy bundles (reference policy_bundles.go)
+    # ------------------------------------------------------------------
+    def _bundles(self):
+        from ..safetykernel.bundles import PolicyBundleAdmin
+
+        if self.configsvc is None:
+            raise web.HTTPNotImplemented(reason="config service not wired")
+        return PolicyBundleAdmin(self.kv, self.configsvc, self.kernel)
+
+    @staticmethod
+    def _bundle_id(request: web.Request) -> str:
+        from ..safetykernel.bundles import unescape_bundle_id
+
+        return unescape_bundle_id(request.match_info["bundle_id"])
+
+    def _require_admin(self, request: web.Request) -> Optional[web.Response]:
+        if request["principal"].role != "admin":
+            return _err(403, "policy administration requires the admin role")
+        return None
+
+    async def bundles_list(self, request: web.Request) -> web.Response:
+        return web.json_response({"bundles": await self._bundles().list_bundles()})
+
+    async def bundles_get(self, request: web.Request) -> web.Response:
+        b = await self._bundles().get_bundle(self._bundle_id(request))
+        return web.json_response(b) if b else _err(404, "unknown bundle")
+
+    async def bundles_put(self, request: web.Request) -> web.Response:
+        if (deny := self._require_admin(request)) is not None:
+            return deny
+        result = await self._bundles().put_bundle(
+            self._bundle_id(request), await request.json(),
+            actor=request["principal"].principal_id,
+        )
+        return web.json_response(result, status=201)
+
+    async def bundles_delete(self, request: web.Request) -> web.Response:
+        if (deny := self._require_admin(request)) is not None:
+            return deny
+        ok = await self._bundles().delete_bundle(
+            self._bundle_id(request), actor=request["principal"].principal_id
+        )
+        return web.json_response({"deleted": ok}, status=200 if ok else 404)
+
+    async def bundles_publish(self, request: web.Request) -> web.Response:
+        if (deny := self._require_admin(request)) is not None:
+            return deny
+        try:
+            result = await self._bundles().publish(
+                self._bundle_id(request), actor=request["principal"].principal_id
+            )
+        except KeyError as e:
+            return _err(404, str(e))
+        return web.json_response(result)
+
+    async def bundles_unpublish(self, request: web.Request) -> web.Response:
+        if (deny := self._require_admin(request)) is not None:
+            return deny
+        try:
+            result = await self._bundles().unpublish(
+                self._bundle_id(request), actor=request["principal"].principal_id
+            )
+        except KeyError as e:
+            return _err(404, str(e))
+        return web.json_response(result)
+
+    async def bundles_simulate(self, request: web.Request) -> web.Response:
+        doc = await request.json()
+        bundle = await self._bundles().get_bundle(self._bundle_id(request))
+        data = doc.get("draft") or (bundle or {}).get("data") or {}
+        results = await self._bundles().simulate_draft(
+            data, [self._policy_check_request(r) for r in (doc.get("requests") or [])]
+        )
+        return web.json_response({"results": results})
+
+    async def snapshots_capture(self, request: web.Request) -> web.Response:
+        if (deny := self._require_admin(request)) is not None:
+            return deny
+        body = await request.json() if request.can_read_body else {}
+        result = await self._bundles().capture_snapshot(
+            actor=request["principal"].principal_id, note=str((body or {}).get("note", ""))
+        )
+        return web.json_response(result, status=201)
+
+    async def snapshots_captured(self, request: web.Request) -> web.Response:
+        return web.json_response({"snapshots": await self._bundles().list_captured()})
+
+    async def snapshots_rollback(self, request: web.Request) -> web.Response:
+        if (deny := self._require_admin(request)) is not None:
+            return deny
+        try:
+            result = await self._bundles().rollback(
+                request.match_info["snapshot_id"], actor=request["principal"].principal_id
+            )
+        except KeyError as e:
+            return _err(404, str(e))
+        return web.json_response(result)
+
+    async def policy_audit(self, request: web.Request) -> web.Response:
+        return web.json_response({"audit": await self._bundles().audit_log()})
 
     # ------------------------------------------------------------------
     # packs (reference gateway packs.go installer endpoints)

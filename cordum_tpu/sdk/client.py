@@ -1,0 +1,205 @@
+"""Typed gateway client (reference ``sdk/client/client.go:23-393``): the
+programmatic integration surface for external tools and tests.
+
+Async (httpx) with a small sync facade; covers jobs, workflows/runs,
+approvals, DLQ, artifacts, context, policy, packs.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional
+
+import httpx
+
+TERMINAL_JOB_STATES = {"SUCCEEDED", "FAILED", "CANCELLED", "TIMEOUT", "DENIED"}
+TERMINAL_RUN_STATES = {"SUCCEEDED", "FAILED", "CANCELLED"}
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class Client:
+    def __init__(
+        self,
+        base_url: str = "http://127.0.0.1:8081",
+        *,
+        api_key: str = "",
+        principal_id: str = "",
+        role: str = "",
+        tenant_id: str = "",
+        timeout_s: float = 30.0,
+    ):
+        headers = {}
+        if api_key:
+            headers["X-Api-Key"] = api_key
+        if principal_id:
+            headers["X-Principal-Id"] = principal_id
+        if role:
+            headers["X-Principal-Role"] = role
+        if tenant_id:
+            headers["X-Tenant-Id"] = tenant_id
+        self._c = httpx.AsyncClient(base_url=base_url, headers=headers, timeout=timeout_s)
+
+    async def close(self) -> None:
+        await self._c.aclose()
+
+    async def __aenter__(self) -> "Client":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def _req(self, method: str, path: str, **kw) -> Any:
+        r = await self._c.request(method, path, **kw)
+        try:
+            body = r.json()
+        except ValueError:
+            body = {"raw": r.text}
+        if r.status_code >= 400:
+            raise ApiError(r.status_code, str(body.get("error", body)))
+        return body
+
+    # -- jobs -----------------------------------------------------------
+    async def submit_job(
+        self,
+        topic: str,
+        payload: Any = None,
+        *,
+        metadata: Optional[dict] = None,
+        labels: Optional[dict] = None,
+        env: Optional[dict] = None,
+        budget: Optional[dict] = None,
+        priority: str = "BATCH",
+        idempotency_key: str = "",
+        memory_id: str = "",
+    ) -> dict:
+        body: dict[str, Any] = {"topic": topic, "payload": payload, "priority": priority}
+        if metadata:
+            body["metadata"] = metadata
+        if labels:
+            body["labels"] = labels
+        if env:
+            body["env"] = env
+        if budget:
+            body["budget"] = budget
+        if idempotency_key:
+            body["idempotency_key"] = idempotency_key
+        if memory_id:
+            body["memory_id"] = memory_id
+        return await self._req("POST", "/api/v1/jobs", json=body)
+
+    async def job_status(self, job_id: str, *, events: bool = False, result: bool = False) -> dict:
+        q = []
+        if events:
+            q.append("events=true")
+        if result:
+            q.append("result=true")
+        qs = ("?" + "&".join(q)) if q else ""
+        return await self._req("GET", f"/api/v1/jobs/{job_id}{qs}")
+
+    async def wait_job(self, job_id: str, *, timeout_s: float = 120.0, poll_s: float = 0.25) -> dict:
+        t0 = time.monotonic()
+        while True:
+            doc = await self.job_status(job_id, result=True)
+            if doc.get("state") in TERMINAL_JOB_STATES:
+                return doc
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(f"job {job_id} not terminal after {timeout_s}s")
+            await asyncio.sleep(poll_s)
+
+    async def cancel_job(self, job_id: str) -> dict:
+        return await self._req("POST", f"/api/v1/jobs/{job_id}/cancel")
+
+    async def remediate_job(self, job_id: str, remediation_id: str = "") -> dict:
+        return await self._req("POST", f"/api/v1/jobs/{job_id}/remediate",
+                               json={"remediation_id": remediation_id})
+
+    # -- approvals ------------------------------------------------------
+    async def list_approvals(self) -> list[dict]:
+        return (await self._req("GET", "/api/v1/approvals"))["approvals"]
+
+    async def approve_job(self, job_id: str) -> dict:
+        return await self._req("POST", f"/api/v1/approvals/{job_id}/approve")
+
+    async def reject_job(self, job_id: str, reason: str = "") -> dict:
+        return await self._req("POST", f"/api/v1/approvals/{job_id}/reject",
+                               json={"reason": reason})
+
+    # -- workflows / runs -----------------------------------------------
+    async def put_workflow(self, doc: dict) -> dict:
+        return await self._req("POST", "/api/v1/workflows", json=doc)
+
+    async def start_run(self, workflow_id: str, input_value: Any = None, *,
+                        idempotency_key: str = "", dry_run: bool = False) -> dict:
+        headers = {"Idempotency-Key": idempotency_key} if idempotency_key else {}
+        return await self._req("POST", f"/api/v1/workflows/{workflow_id}/runs",
+                               json={"input": input_value, "dry_run": dry_run}, headers=headers)
+
+    async def run_status(self, run_id: str) -> dict:
+        return await self._req("GET", f"/api/v1/runs/{run_id}")
+
+    async def wait_run(self, run_id: str, *, timeout_s: float = 300.0, poll_s: float = 0.25) -> dict:
+        t0 = time.monotonic()
+        while True:
+            doc = await self.run_status(run_id)
+            if doc.get("status") in TERMINAL_RUN_STATES:
+                return doc
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(f"run {run_id} not terminal after {timeout_s}s")
+            await asyncio.sleep(poll_s)
+
+    async def approve_step(self, run_id: str, step_id: str, *, approve: bool = True) -> dict:
+        return await self._req("POST", f"/api/v1/runs/{run_id}/steps/{step_id}/approve",
+                               json={"approve": approve})
+
+    async def run_timeline(self, run_id: str) -> list[dict]:
+        return (await self._req("GET", f"/api/v1/runs/{run_id}/timeline"))["timeline"]
+
+    async def cancel_run(self, run_id: str) -> dict:
+        return await self._req("POST", f"/api/v1/runs/{run_id}/cancel")
+
+    async def rerun(self, run_id: str, from_step: str, *, dry_run: bool = False) -> dict:
+        return await self._req("POST", f"/api/v1/runs/{run_id}/rerun",
+                               json={"from_step": from_step, "dry_run": dry_run})
+
+    # -- dlq / artifacts / context / misc --------------------------------
+    async def list_dlq(self, offset: int = 0, limit: int = 50) -> dict:
+        return await self._req("GET", f"/api/v1/dlq?offset={offset}&limit={limit}")
+
+    async def retry_dlq(self, job_id: str) -> dict:
+        return await self._req("POST", f"/api/v1/dlq/{job_id}/retry")
+
+    async def put_artifact(self, data: bytes, *, retention: str = "standard") -> dict:
+        return await self._req("POST", f"/api/v1/artifacts?retention={retention}", content=data)
+
+    async def get_artifact(self, artifact_id: str) -> bytes:
+        r = await self._c.get(f"/api/v1/artifacts/{artifact_id}")
+        if r.status_code >= 400:
+            raise ApiError(r.status_code, r.text)
+        return r.content
+
+    async def build_window(self, memory_id: str, *, mode: str = "RAW", payload: Any = None,
+                           max_input_tokens: int = 4000) -> list[dict]:
+        doc = await self._req("POST", "/api/v1/context/window", json={
+            "memory_id": memory_id, "mode": mode, "payload": payload,
+            "max_input_tokens": max_input_tokens})
+        return doc["messages"]
+
+    async def update_memory(self, memory_id: str, *, payload: Any = None,
+                            model_response: str = "") -> None:
+        await self._req("POST", f"/api/v1/context/memory/{memory_id}",
+                        json={"payload": payload, "model_response": model_response})
+
+    async def status(self) -> dict:
+        return await self._req("GET", "/api/v1/status")
+
+    async def workers(self) -> dict:
+        return await self._req("GET", "/api/v1/workers")
+
+    async def install_pack(self, manifest: dict) -> dict:
+        return await self._req("POST", "/api/v1/packs", json=manifest)

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Minimal external worker (reference ``examples/hello-worker-go`` /
+``python-worker``): connects to the statebus, consumes its pool topic,
+fetches the context pointer, writes a result pointer, publishes JobResult —
+using only the SDK worker runtime.
+
+Run: CORDUM_STATEBUS_URL=statebus://127.0.0.1:7420 python worker.py
+"""
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from cordum_tpu.infra import statebus
+from cordum_tpu.infra.memstore import MemoryStore
+from cordum_tpu.worker.runtime import JobContext, Worker
+
+
+async def main() -> None:
+    kv, bus, conn = await statebus.connect()
+    worker = Worker(
+        bus=bus,
+        store=MemoryStore(kv),
+        worker_id=os.environ.get("WORKER_ID", "hello-python-worker"),
+        pool=os.environ.get("WORKER_POOL", "default"),
+        topics=[os.environ.get("WORKER_TOPIC", "job.hello-pack.echo")],
+        capabilities=["echo"],
+    )
+
+    async def echo(ctx: JobContext) -> dict:
+        print(f"handling {ctx.request.job_id}: {ctx.payload}")
+        return {"echo": ctx.payload, "worker": worker.worker_id}
+
+    worker.register_default(echo)
+    await worker.start()
+    print(f"worker {worker.worker_id} consuming {worker.topics}; Ctrl-C to stop")
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await worker.stop()
+        await conn.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

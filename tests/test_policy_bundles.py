@@ -1,0 +1,103 @@
+"""Policy bundle admin: staged writes, publish/unpublish, draft simulation,
+snapshot capture/rollback, audit trail — library + HTTP."""
+import pytest
+
+from cordum_tpu.controlplane.safetykernel.bundles import PolicyBundleAdmin, unescape_bundle_id
+from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+from cordum_tpu.infra.configsvc import ConfigService
+from cordum_tpu.infra.kv import MemoryKV
+from cordum_tpu.protocol.types import PolicyCheckRequest
+
+BASE = {"tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}}
+
+DENY_BUNDLE = {"rules": [{"id": "no-x", "match": {"topics": ["job.x"]}, "decision": "deny"}]}
+
+
+async def make_admin(kv):
+    cs = ConfigService(kv)
+    kernel = SafetyKernel(policy_doc=BASE, configsvc=cs)
+    await kernel.reload()
+    return PolicyBundleAdmin(kv, cs, kernel), kernel
+
+
+async def test_staged_bundle_then_publish(kv):
+    admin, kernel = await make_admin(kv)
+    await admin.put_bundle("team/deny-x", DENY_BUNDLE, actor="alice")
+    # staged: disabled → no effect yet
+    resp = await kernel.evaluate_raw(PolicyCheckRequest(topic="job.x"))
+    assert resp.decision == "ALLOW"
+    bundles = await admin.list_bundles()
+    assert bundles[0]["bundle_id"] == "team/deny-x" and not bundles[0]["enabled"]
+    # publish → active
+    result = await admin.publish("team/deny-x", actor="alice")
+    assert result["enabled"]
+    resp = await kernel.evaluate_raw(PolicyCheckRequest(topic="job.x"))
+    assert resp.decision == "DENY"
+    # unpublish → inactive again
+    await admin.unpublish("team/deny-x", actor="alice")
+    resp = await kernel.evaluate_raw(PolicyCheckRequest(topic="job.x"))
+    assert resp.decision == "ALLOW"
+    audit = await admin.audit_log()
+    assert [e["action"] for e in audit] == ["put_bundle", "publish", "unpublish"]
+    assert all(e["actor"] == "alice" for e in audit)
+
+
+async def test_draft_simulation_without_install(kv):
+    admin, kernel = await make_admin(kv)
+    results = await admin.simulate_draft(DENY_BUNDLE, [PolicyCheckRequest(topic="job.x")])
+    assert results[0]["decision"] == "DENY"
+    # live policy untouched
+    resp = await kernel.evaluate_raw(PolicyCheckRequest(topic="job.x"))
+    assert resp.decision == "ALLOW"
+
+
+async def test_snapshot_capture_and_rollback(kv):
+    admin, kernel = await make_admin(kv)
+    await admin.put_bundle("good", {"enabled": True, "rules": []}, actor="a")
+    cap = await admin.capture_snapshot(actor="a", note="before risky change")
+    # risky change: a deny-everything bundle
+    await admin.put_bundle(
+        "risky", {"enabled": True,
+                  "rules": [{"id": "all", "match": {"topics": ["job.>"]}, "decision": "deny"}]},
+        actor="a",
+    )
+    assert (await kernel.evaluate_raw(PolicyCheckRequest(topic="job.any.thing"))).decision == "DENY"
+    # rollback removes the bundle added after the capture
+    result = await admin.rollback(cap["snapshot_id"], actor="a")
+    assert result["rolled_back_to"] == cap["snapshot_id"]
+    assert (await kernel.evaluate_raw(PolicyCheckRequest(topic="job.any.thing"))).decision == "ALLOW"
+    assert await admin.get_bundle("good") is not None
+    assert await admin.get_bundle("risky") is None
+    snaps = await admin.list_captured()
+    assert snaps and snaps[0]["note"] == "before risky change"
+
+
+def test_bundle_id_escaping():
+    assert unescape_bundle_id("team~deny-x") == "team/deny-x"
+
+
+async def test_bundles_http():
+    from tests.test_gateway import GwStack
+
+    async with GwStack() as s:
+        r = await s.client.put("/api/v1/policy/bundles/team~frag", json=DENY_BUNDLE, headers=s.h())
+        assert r.status == 403
+        r = await s.client.put("/api/v1/policy/bundles/team~frag", json=DENY_BUNDLE,
+                               headers=s.h(admin=True))
+        assert r.status == 201
+        r = await s.client.get("/api/v1/policy/bundles", headers=s.h())
+        assert (await r.json())["bundles"][0]["bundle_id"] == "team/frag"
+        r = await s.client.post("/api/v1/policy/bundles/team~frag/simulate",
+                                json={"requests": [{"topic": "job.x"}]}, headers=s.h())
+        assert (await r.json())["results"][0]["decision"] == "DENY"
+        r = await s.client.post("/api/v1/policy/bundles/team~frag/publish", headers=s.h(admin=True))
+        assert (await r.json())["enabled"]
+        r = await s.client.post("/api/v1/policy/snapshots/capture", json={"note": "n"},
+                                headers=s.h(admin=True))
+        snap_id = (await r.json())["snapshot_id"]
+        r = await s.client.post(f"/api/v1/policy/snapshots/{snap_id}/rollback",
+                                headers=s.h(admin=True))
+        assert r.status == 200
+        r = await s.client.get("/api/v1/policy/audit", headers=s.h())
+        actions = [e["action"] for e in (await r.json())["audit"]]
+        assert "publish" in actions and "rollback" in actions

@@ -1,0 +1,71 @@
+"""SDK client against the live gateway stack."""
+import pytest
+
+from cordum_tpu.sdk.client import ApiError, Client
+from tests.test_gateway import GwStack
+
+
+async def test_sdk_job_flow():
+    async with GwStack() as s:
+        c = Client(str(s.client.make_url("")), api_key="user-key")
+        try:
+            doc = await c.submit_job("job.work", {"n": 7})
+            final = await c.wait_job(doc["job_id"])
+            assert final["state"] == "SUCCEEDED"
+            assert final["result"]["echo"] == {"n": 7}
+            st = await c.status()
+            assert st["bus"]
+        finally:
+            await c.close()
+
+
+async def test_sdk_workflow_and_approvals():
+    async with GwStack() as s:
+        user = Client(str(s.client.make_url("")), api_key="user-key")
+        admin = Client(str(s.client.make_url("")), api_key="admin-key")
+        try:
+            await user.put_workflow({
+                "id": "sdkwf",
+                "steps": {"gate": {"type": "approval"},
+                          "go": {"topic": "job.work", "depends_on": ["gate"]}},
+            })
+            run = await user.start_run("sdkwf", {"x": 1})
+            await admin.approve_step(run["run_id"], "gate")
+            final = await user.wait_run(run["run_id"])
+            assert final["status"] == "SUCCEEDED"
+            tl = await user.run_timeline(run["run_id"])
+            assert any(e["event"] == "approved" for e in tl)
+            # job-level approvals
+            doc = await user.submit_job("job.deploy.api", {})
+            import asyncio
+
+            for _ in range(50):
+                st = await user.job_status(doc["job_id"])
+                if st["state"] == "APPROVAL_REQUIRED":
+                    break
+                await asyncio.sleep(0.05)
+            approvals = await admin.list_approvals()
+            assert any(a["job_id"] == doc["job_id"] for a in approvals)
+            with pytest.raises(ApiError):
+                await user.approve_job(doc["job_id"])  # non-admin
+            await admin.approve_job(doc["job_id"])
+        finally:
+            await user.close()
+            await admin.close()
+
+
+async def test_sdk_artifacts_and_context():
+    async with GwStack() as s:
+        from cordum_tpu.context.service import ContextService
+
+        s.gw.context_svc = ContextService(s.kv)
+        c = Client(str(s.client.make_url("")), api_key="user-key")
+        try:
+            up = await c.put_artifact(b"model-blob", retention="short")
+            data = await c.get_artifact(up["artifact_id"])
+            assert data == b"model-blob"
+            await c.update_memory("m1", payload="hi", model_response="hello!")
+            msgs = await c.build_window("m1", mode="CHAT", payload="next")
+            assert [m["content"] for m in msgs] == ["hi", "hello!", "next"]
+        finally:
+            await c.close()
