@@ -261,6 +261,7 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None):
     jstep = jax.jit(
         step,
         in_shardings=(param_shardings, None, batch_sharding),
+        out_shardings=(param_shardings, None, None),
         donate_argnums=(0, 1),
     )
 
