@@ -167,6 +167,23 @@ def make_tpu_handlers(compute: TPUCompute):
             return await ctx.worker.run_in_executor(
                 functools.partial(compute.infer, tokens, payload.get("max_len"))
             )
+        if op == "train":
+            import asyncio
+
+            from .training import TrainRunner
+
+            loop = asyncio.get_running_loop()
+
+            def report(frac, msg):
+                asyncio.run_coroutine_threadsafe(ctx.progress(100 * frac, msg), loop)
+
+            runner = TrainRunner()
+            return await ctx.worker.run_in_executor(
+                functools.partial(
+                    runner.train, payload,
+                    cancelled=ctx.cancelled.is_set, progress=report,
+                )
+            )
         raise HandlerError(f"unknown op {op!r}")
 
     return handler
