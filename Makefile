@@ -1,0 +1,25 @@
+PY ?= python
+
+.PHONY: test test-fast smoke bench up init dryrun lint
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -x --ignore=tests/test_models.py --ignore=tests/test_moe_pipeline.py --ignore=tests/test_training.py
+
+smoke:
+	$(PY) tools/platform_smoke.py
+
+bench:
+	$(PY) bench.py
+
+up:
+	$(PY) -m cordum_tpu.cli up
+
+init:
+	$(PY) -m cordum_tpu.cli init
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); import __graft_entry__ as g; g.dryrun_multichip(8)"
