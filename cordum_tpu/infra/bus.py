@@ -169,9 +169,10 @@ class LoopbackBus(Bus):
             return False
         mid = compute_msg_id(subject, pkt)
         now = time.monotonic()
-        # prune occasionally
-        if len(self._dedup) > 4096:
-            self._dedup = {k: t for k, t in self._dedup.items() if now - t < DEDUP_WINDOW_S}
+        # amortized prune: evict the oldest half (insertion-ordered dict)
+        if len(self._dedup) > 8192:
+            for k in list(itertools.islice(self._dedup, 4096)):
+                del self._dedup[k]
         seen = self._dedup.get(mid)
         if seen is not None and now - seen < DEDUP_WINDOW_S:
             return True

@@ -121,8 +121,7 @@ class JobStore:
         Raises :class:`IllegalTransition` otherwise."""
         key = meta_key(job_id)
         for _ in range(max_retries):
-            ver = await self.kv.version(key)
-            h = await self.kv.hgetall(key)
+            ver, h = await self.kv.watch_read(key)
             prev = h.get("state", b"").decode()
             if prev == state.value:
                 if fields:

@@ -126,6 +126,12 @@ class KV:
         """Monotonic per-key version (bumped on every mutation); 0 if absent."""
         raise NotImplementedError
 
+    async def watch_read(self, key: str) -> tuple[int, dict[str, bytes]]:
+        """Atomic (version, hash-contents) snapshot — one round trip for the
+        optimistic read-modify-write loop."""
+        ver = await self.version(key)
+        return ver, await self.hgetall(key)
+
     async def commit(
         self,
         watches: dict[str, int],
@@ -180,6 +186,18 @@ class MemoryKV(KV):
             expires_at = prev.expires_at
         e = _Entry(value, expires_at, self._global_version)
         self._data[key] = e
+        return e
+
+    def _touch(self, e: _Entry) -> None:
+        """Bump the version of an in-place-mutated container (no copy —
+        containers can be large: indexes, event logs)."""
+        self._global_version += 1
+        e.version = self._global_version
+
+    def _container(self, key: str, factory) -> _Entry:
+        e = self._live(key)
+        if e is None or not isinstance(e.value, type(factory())):
+            e = self._bump(key, factory())
         return e
 
     # strings -------------------------------------------------------------
@@ -240,22 +258,20 @@ class MemoryKV(KV):
             if e is None or not isinstance(e.value, dict):
                 return 0
             n = 0
-            h = dict(e.value)
             for f in fields:
-                if f in h:
-                    del h[f]
+                if f in e.value:
+                    del e.value[f]
                     n += 1
             if n:
-                self._bump(key, h, keep_ttl=True)
+                self._touch(e)
             return n
 
     async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
         async with self._lock:
-            e = self._live(key)
-            h = dict(e.value) if e is not None and isinstance(e.value, dict) else {}
-            cur = int(h.get(field, b"0")) + amount
-            h[field] = str(cur).encode()
-            self._bump(key, h, keep_ttl=True)
+            e = self._container(key, dict)
+            cur = int(e.value.get(field, b"0")) + amount
+            e.value[field] = str(cur).encode()
+            self._touch(e)
             return cur
 
     # sorted sets ---------------------------------------------------------
@@ -325,11 +341,8 @@ class MemoryKV(KV):
             if e is None or not isinstance(e.value, list):
                 return
             lst = e.value
-            if stop == -1:
-                new = lst[start:]
-            else:
-                new = lst[start : stop + 1]
-            self._bump(key, new, keep_ttl=True)
+            e.value = lst[start:] if stop == -1 else lst[start : stop + 1]
+            self._touch(e)
 
     async def llen(self, key: str) -> int:
         async with self._lock:
@@ -339,12 +352,11 @@ class MemoryKV(KV):
     # sets ----------------------------------------------------------------
     async def sadd(self, key: str, *members: str) -> int:
         async with self._lock:
-            e = self._live(key)
-            s = set(e.value) if e is not None and isinstance(e.value, set) else set()
-            n = len(set(members) - s)
-            s |= set(members)
-            self._bump(key, s, keep_ttl=True)
-            return n
+            e = self._container(key, set)
+            before = len(e.value)
+            e.value.update(members)
+            self._touch(e)
+            return len(e.value) - before
 
     async def smembers(self, key: str) -> set[str]:
         async with self._lock:
@@ -356,6 +368,14 @@ class MemoryKV(KV):
         async with self._lock:
             e = self._live(key)
             return e.version if e is not None else 0
+
+    async def watch_read(self, key: str) -> tuple[int, dict[str, bytes]]:
+        async with self._lock:
+            e = self._live(key)
+            if e is None:
+                return 0, {}
+            h = dict(e.value) if isinstance(e.value, dict) else {}
+            return e.version, h
 
     # op appliers used by commit(); all assume lock held
     def _set_op(self, key: str, value: bytes, ttl_s: Optional[float] = None) -> None:
@@ -370,37 +390,33 @@ class MemoryKV(KV):
         return n
 
     def _hset_op(self, key: str, mapping: dict[str, bytes]) -> None:
-        e = self._live(key)
-        h = dict(e.value) if e is not None and isinstance(e.value, dict) else {}
-        h.update(mapping)
-        self._bump(key, h, keep_ttl=True)
+        e = self._container(key, dict)
+        e.value.update(mapping)
+        self._touch(e)
 
     def _zadd_op(self, key: str, member: str, score: float) -> None:
-        e = self._live(key)
-        z = dict(e.value) if e is not None and isinstance(e.value, dict) else {}
-        z[member] = score
-        self._bump(key, z, keep_ttl=True)
+        e = self._container(key, dict)
+        e.value[member] = score
+        self._touch(e)
 
     def _zrem_op(self, key: str, *members: str) -> int:
         e = self._live(key)
         if e is None or not isinstance(e.value, dict):
             return 0
-        z = dict(e.value)
         n = 0
         for m in members:
-            if m in z:
-                del z[m]
+            if m in e.value:
+                del e.value[m]
                 n += 1
         if n:
-            self._bump(key, z, keep_ttl=True)
+            self._touch(e)
         return n
 
     def _rpush_op(self, key: str, *values: bytes) -> int:
-        e = self._live(key)
-        lst = list(e.value) if e is not None and isinstance(e.value, list) else []
-        lst.extend(values)
-        self._bump(key, lst, keep_ttl=True)
-        return len(lst)
+        e = self._container(key, list)
+        e.value.extend(values)
+        self._touch(e)
+        return len(e.value)
 
     def _expire_op(self, key: str, ttl_s: float) -> None:
         e = self._live(key)

@@ -45,7 +45,8 @@ _KV_OPS = {
     "hincrby": True, "zadd": True, "zrem": True, "zrange": False,
     "zrangebyscore": False, "zcard": False, "zscore": False, "rpush": True,
     "lrange": False, "ltrim": True, "llen": False, "sadd": True,
-    "smembers": False, "version": False, "commit": True, "ping": False,
+    "smembers": False, "version": False, "watch_read": False, "commit": True,
+    "ping": False,
 }
 
 
@@ -195,8 +196,9 @@ class StateBusServer:
                 mid = ""
             if mid:
                 now = time.monotonic()
-                if len(self._dedup) > 8192:
-                    self._dedup = {k: t for k, t in self._dedup.items() if now - t < DEDUP_WINDOW_S}
+                if len(self._dedup) > 16384:
+                    for k in list(itertools.islice(self._dedup, 8192)):
+                        del self._dedup[k]
                 seen = self._dedup.get(mid)
                 if seen is not None and now - seen < DEDUP_WINDOW_S:
                     return
@@ -319,6 +321,9 @@ def _make_kv_method(op: str):
             return set(result)
         if op == "hgetall" and isinstance(result, dict):
             return {k if isinstance(k, str) else k.decode(): v for k, v in result.items()}
+        if op == "watch_read" and isinstance(result, (list, tuple)):
+            ver, h = result
+            return ver, {k if isinstance(k, str) else k.decode(): v for k, v in (h or {}).items()}
         return result
 
     method.__name__ = op

@@ -156,13 +156,33 @@ class Decision(str, enum.Enum):
 # ---------------------------------------------------------------------------
 
 
+_FIELD_CACHE: dict[type, tuple[str, ...]] = {}
+
+
+def _field_names(cls: type) -> tuple[str, ...]:
+    names = _FIELD_CACHE.get(cls)
+    if names is None:
+        names = tuple(f.name for f in dataclasses.fields(cls))
+        _FIELD_CACHE[cls] = names
+    return names
+
+
 def _to_plain(v: Any) -> Any:
-    if dataclasses.is_dataclass(v) and not isinstance(v, type):
-        return {
-            f.name: _to_plain(getattr(v, f.name))
-            for f in dataclasses.fields(v)
-            if getattr(v, f.name) is not None
-        }
+    # fast paths first: the wire hot loop is dominated by str/int/dict
+    t = type(v)
+    if t is str or t is int or t is float or t is bool or t is bytes or v is None:
+        return v
+    if t is dict:
+        return {k: _to_plain(x) for k, x in v.items()}
+    if t is list or t is tuple:
+        return [_to_plain(x) for x in v]
+    if dataclasses.is_dataclass(v):
+        out = {}
+        for name in _field_names(t):
+            val = getattr(v, name)
+            if val is not None:
+                out[name] = _to_plain(val)
+        return out
     if isinstance(v, enum.Enum):
         return v.value
     if isinstance(v, dict):
