@@ -125,8 +125,40 @@ def bench_embeds() -> dict:
         return {"embeds_per_sec": 0.0, "embed_error": str(ex)[:120]}
 
 
+def bench_selection() -> dict:
+    """Worker-selection throughput at 1000 workers (reference analogue:
+    18,234 selections/s, BENCHMARKS.md:131)."""
+    import random
+
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.registry import WorkerRegistry
+    from cordum_tpu.protocol.types import Heartbeat, JobRequest
+
+    rng = random.Random(9)
+    reg = WorkerRegistry()
+    for i in range(1000):
+        reg.update(Heartbeat(
+            worker_id=f"w{i:05d}", pool="tpu", capabilities=["tpu"],
+            chip_count=rng.choice([1, 4, 8]), active_jobs=rng.randint(0, 12),
+            max_parallel_jobs=16, cpu_load=rng.uniform(0, 100),
+            tpu_duty_cycle=rng.uniform(0, 100),
+        ))
+    pc = parse_pool_config({"topics": {"job.tpu.work": "tpu"}, "pools": {"tpu": {"requires": ["tpu"]}}})
+    strat = LeastLoadedStrategy(reg, pc)
+    req = JobRequest(job_id="j", topic="job.tpu.work")
+    strat.pick_subject(req)
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        strat.pick_subject(req)
+    dt = time.perf_counter() - t0
+    return {"selections_per_sec": n / dt, "native": strat._packed is not None}
+
+
 def main() -> None:
     sched = asyncio.run(bench_scheduler())
+    sel = bench_selection()
     emb = bench_embeds()
     out = {
         "metric": "scheduled_jobs_per_sec",
@@ -135,6 +167,8 @@ def main() -> None:
         "vs_baseline": round(sched["jobs_per_sec"] / BASELINE_JOBS_PER_SEC, 3),
         "p50_e2e_ms": round(sched["p50_e2e_ms"], 2),
         "jobs": sched["jobs"],
+        "selections_per_sec": round(sel["selections_per_sec"], 1),
+        "native_scan": sel["native"],
         "embeds_per_sec": round(emb.get("embeds_per_sec", 0.0), 1),
     }
     if "embed_device" in emb:

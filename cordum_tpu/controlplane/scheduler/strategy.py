@@ -113,17 +113,49 @@ def _placement_labels(labels: dict[str, str]) -> dict[str, str]:
 
 
 class LeastLoadedStrategy(Strategy):
-    def __init__(self, registry: WorkerRegistry, pool_config: PoolConfig):
+    def __init__(self, registry: WorkerRegistry, pool_config: PoolConfig, *, native: bool = True):
         self.registry = registry
         self._pool_config = pool_config
+        self._packed = None
+        if native:
+            try:
+                from .native_scan import PackedWorkers
+
+                packed = PackedWorkers(registry)
+                if packed.available:
+                    self._packed = packed
+            except Exception:  # no compiler / load failure → pure python
+                self._packed = None
 
     def update_routing(self, pool_config: PoolConfig) -> None:
         self._pool_config = pool_config
 
+    def _native_pick(self, req: JobRequest, pools, job_requires) -> Optional[str]:
+        """Native packed scan for the common shape; LookupError → python."""
+        if self._packed is None:
+            raise LookupError("native disabled")
+        # pools must agree on constraints for the single-pass C scan
+        first = pools[0]
+        for p in pools[1:]:
+            if (p.requires, p.min_chips, p.topology, p.device_kind) != (
+                first.requires, first.min_chips, first.topology, first.device_kind
+            ):
+                raise LookupError("divergent pool constraints")
+        if first.device_kind:
+            raise LookupError("device_kind filter not in native scan")
+        req_caps, min_chips, topology = _parse_tpu_requires(job_requires)
+        pool_caps, pool_chips, pool_topology = _parse_tpu_requires(first.requires)
+        winner = self._packed.pick(
+            required_caps=req_caps + pool_caps,
+            pool_names=[p.name for p in pools],
+            min_chips=max(min_chips, pool_chips, first.min_chips),
+            topology=topology or pool_topology or first.topology,
+        )
+        return winner
+
     def pick_subject(self, req: JobRequest) -> str:
         labels = req.labels or {}
         job_requires = list(req.metadata.requires) if req.metadata else []
-        workers = self.registry.snapshot()
 
         pools = self._pool_config.pools_for_topic(req.topic)
         if not pools:
@@ -136,7 +168,7 @@ class LeastLoadedStrategy(Strategy):
         # a hint can never route a job to a worker that cannot run it
         preferred_worker = labels.get("preferred_worker_id", "")
         if preferred_worker:
-            hb = workers.get(preferred_worker)
+            hb = self.registry.get(preferred_worker)
             if hb is not None and not is_overloaded(hb):
                 pool = next((p for p in pools if p.name == hb.pool), None) if pools else None
                 pool_ok = pool is not None or not pools
@@ -149,9 +181,17 @@ class LeastLoadedStrategy(Strategy):
             if hinted:
                 pools = hinted
 
+        # native packed scan (the hot path: no hints, uniform pools)
+        if not placement and not preferred_worker:
+            try:
+                winner = self._native_pick(req, pools, job_requires)
+                return direct_subject(winner) if winner else req.topic
+            except LookupError:
+                pass  # shapes the C kernel doesn't model → python scan
+
         best_worker = ""
         best_score = float("inf")
-        for hb in workers.values():
+        for hb in self.registry.snapshot().values():
             # pool membership: worker's reported pool must be one of the
             # topic's pools (when the topic maps to pools at all)
             pool: Optional[Pool] = None

@@ -25,13 +25,16 @@ class WorkerRegistry:
     def __init__(self, ttl_s: float = DEFAULT_TTL_S):
         self.ttl_s = ttl_s
         self._workers: dict[str, WorkerInfo] = {}
+        self.version = 0  # bumped on every mutation (packed-scan cache key)
 
     def update(self, hb: Heartbeat) -> None:
         if hb.worker_id:
             self._workers[hb.worker_id] = WorkerInfo(hb, time.monotonic())
+            self.version += 1
 
     def remove(self, worker_id: str) -> None:
-        self._workers.pop(worker_id, None)
+        if self._workers.pop(worker_id, None) is not None:
+            self.version += 1
 
     def expire(self) -> list[str]:
         """Drop workers whose heartbeat is older than TTL; returns dropped ids."""
@@ -39,6 +42,8 @@ class WorkerRegistry:
         dead = [wid for wid, info in self._workers.items() if info.last_seen < cutoff]
         for wid in dead:
             del self._workers[wid]
+        if dead:
+            self.version += 1
         return dead
 
     def get(self, worker_id: str) -> Optional[Heartbeat]:

@@ -1,0 +1,72 @@
+"""Native (C) acceleration for control-plane hot loops.
+
+Builds lazily with the system compiler on first use and loads via ctypes
+(no pybind11 in the image); every consumer has a pure-Python fallback, so
+the framework works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+from ..infra import logging as logx
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "strategy_scan.c")
+_LIB = os.path.join(_DIR, "libstrategy_scan.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+                check=True, capture_output=True, timeout=60,
+            )
+            return True
+        except (FileNotFoundError, subprocess.CalledProcessError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def load_strategy_scan() -> Optional[ctypes.CDLL]:
+    """The compiled scan library, or None (callers fall back to Python)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                logx.warn("native strategy scan unavailable (no C compiler)")
+                return None
+        lib = ctypes.CDLL(_LIB)
+        lib.pick_worker.restype = ctypes.c_int32
+        lib.pick_worker.argtypes = [
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),   # cap_bits
+            ctypes.POINTER(ctypes.c_int32),    # pool_id
+            ctypes.POINTER(ctypes.c_int32),    # topology_id
+            ctypes.POINTER(ctypes.c_int32),    # chip_count
+            ctypes.POINTER(ctypes.c_float),    # active_jobs
+            ctypes.POINTER(ctypes.c_float),    # max_parallel
+            ctypes.POINTER(ctypes.c_float),    # cpu_load
+            ctypes.POINTER(ctypes.c_float),    # duty_cycle
+            ctypes.POINTER(ctypes.c_uint8),    # healthy
+            ctypes.c_uint64,                   # req_caps
+            ctypes.POINTER(ctypes.c_int32),    # allowed_pools
+            ctypes.c_int32,                    # n_pools
+            ctypes.c_int32,                    # min_chips
+            ctypes.c_int32,                    # req_topology_id
+        ]
+        _lib = lib
+        logx.info("native strategy scan loaded", lib=_LIB)
+    except OSError as e:
+        logx.warn("native strategy scan failed to load", err=str(e))
+        _lib = None
+    return _lib

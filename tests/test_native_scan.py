@@ -1,0 +1,107 @@
+"""Native C worker-selection scan: correctness vs the Python scan, and the
+selection-throughput microbenchmark shape."""
+import random
+
+import pytest
+
+from cordum_tpu.controlplane.scheduler.strategy import (
+    LeastLoadedStrategy,
+    is_overloaded,
+    load_score,
+    worker_satisfies,
+)
+from cordum_tpu.infra.config import parse_pool_config
+from cordum_tpu.infra.registry import WorkerRegistry
+from cordum_tpu.native import load_strategy_scan
+from cordum_tpu.protocol.types import Heartbeat, JobMetadata, JobRequest
+
+pytestmark = pytest.mark.skipif(
+    load_strategy_scan() is None, reason="no C compiler available"
+)
+
+
+def random_registry(n, seed=0):
+    rng = random.Random(seed)
+    reg = WorkerRegistry()
+    for i in range(n):
+        reg.update(Heartbeat(
+            worker_id=f"w{i:05d}",
+            pool=rng.choice(["tpu", "cpu"]),
+            capabilities=rng.choice([["tpu"], ["tpu", "echo"], ["echo"]]),
+            chip_count=rng.choice([1, 4, 8]),
+            slice_topology=rng.choice(["", "2x2x1", "2x2x2"]),
+            active_jobs=rng.randint(0, 12),
+            max_parallel_jobs=10,
+            cpu_load=rng.uniform(0, 100),
+            tpu_duty_cycle=rng.uniform(0, 100),
+            devices_healthy=rng.random() > 0.05,
+        ))
+    return reg
+
+
+POOL_DOC = {"topics": {"job.tpu.work": "tpu"}, "pools": {"tpu": {"requires": ["tpu"]}}}
+
+
+@pytest.mark.parametrize("requires", [[], ["chips:8"], ["topology:2x2x1"], ["chips:4", "tpu"]])
+def test_native_matches_python(requires):
+    reg = random_registry(300, seed=42)
+    native = LeastLoadedStrategy(reg, parse_pool_config(POOL_DOC), native=True)
+    python = LeastLoadedStrategy(reg, parse_pool_config(POOL_DOC), native=False)
+    assert native._packed is not None, "native scan should be available"
+    req = JobRequest(job_id="j", topic="job.tpu.work",
+                     metadata=JobMetadata(requires=requires))
+    assert native.pick_subject(req) == python.pick_subject(req)
+
+
+def test_native_matches_python_across_registry_mutations():
+    reg = random_registry(100, seed=7)
+    native = LeastLoadedStrategy(reg, parse_pool_config(POOL_DOC), native=True)
+    python = LeastLoadedStrategy(reg, parse_pool_config(POOL_DOC), native=False)
+    req = JobRequest(job_id="j", topic="job.tpu.work")
+    assert native.pick_subject(req) == python.pick_subject(req)
+    # heartbeat mutation invalidates the packed cache
+    reg.update(Heartbeat(worker_id="w00001", pool="tpu", capabilities=["tpu"],
+                         active_jobs=0, max_parallel_jobs=100))
+    assert native.pick_subject(req) == python.pick_subject(req)
+    reg.remove("w00001")
+    assert native.pick_subject(req) == python.pick_subject(req)
+
+
+def test_native_no_eligible_falls_to_topic():
+    reg = random_registry(50, seed=3)
+    strat = LeastLoadedStrategy(reg, parse_pool_config(POOL_DOC), native=True)
+    req = JobRequest(job_id="j", topic="job.tpu.work",
+                     metadata=JobMetadata(requires=["chips:999"]))
+    assert strat.pick_subject(req) == "job.tpu.work"
+
+
+def test_native_hints_use_python_path():
+    reg = random_registry(50, seed=4)
+    strat = LeastLoadedStrategy(reg, parse_pool_config(POOL_DOC), native=True)
+    req = JobRequest(job_id="j", topic="job.tpu.work",
+                     labels={"placement.zone": "nowhere"})
+    assert strat.pick_subject(req) == "job.tpu.work"  # no zone labels → fan-in
+
+
+def test_selection_throughput_native_vs_python():
+    import time
+
+    reg = random_registry(1000, seed=9)
+    native = LeastLoadedStrategy(reg, parse_pool_config(POOL_DOC), native=True)
+    python = LeastLoadedStrategy(reg, parse_pool_config(POOL_DOC), native=False)
+    req = JobRequest(job_id="j", topic="job.tpu.work")
+    native.pick_subject(req)  # warm the pack
+
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        native.pick_subject(req)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n // 10):
+        python.pick_subject(req)
+    t_python = (time.perf_counter() - t0) * 10
+    native_rate = n / t_native
+    # reference publishes 18,234 selections/s at 1000 workers
+    assert native_rate > 20000, f"native scan only {native_rate:.0f}/s"
+    assert t_native < t_python, "native scan should beat the python scan"
