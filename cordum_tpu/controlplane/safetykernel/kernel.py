@@ -51,9 +51,16 @@ class SafetyKernel:
         policy_path: str = "",
         configsvc: Optional[ConfigService] = None,
         cache_ttl_s: float = DEFAULT_CACHE_TTL_S,
+        public_key_path: str = "",
     ):
         self._file_doc = policy_doc or {}
         self._policy_path = policy_path
+        # signed bundles: when a pubkey is configured, the policy file must
+        # carry a valid detached ed25519 signature at <path>.sig — fail
+        # closed to the previous (or empty) policy otherwise
+        import os as _os
+
+        self._public_key_path = public_key_path or _os.environ.get("SAFETY_POLICY_PUBKEY", "")
         self._configsvc = configsvc
         self._cache_ttl_s = cache_ttl_s
         self._cache: dict[str, tuple[float, PolicyCheckResponse]] = {}
@@ -73,8 +80,41 @@ class SafetyKernel:
         doc = copy.deepcopy(self._file_doc)
         if self._policy_path:
             try:
-                with open(self._policy_path) as f:
-                    doc = yaml.safe_load(f) or {}
+                with open(self._policy_path, "rb") as f:
+                    raw = f.read()
+                if self._public_key_path:
+                    ok = False
+                    try:
+                        with open(self._policy_path + ".sig", "rb") as f:
+                            sig = f.read()
+                        with open(self._public_key_path, "rb") as f:
+                            pub = f.read()
+                        ok = verify_signature(raw, sig, pub)
+                    except FileNotFoundError:
+                        ok = False
+                    if not ok:
+                        import logging as _l
+
+                        _l.getLogger("cordum").error(
+                            "policy signature verification FAILED for %s; "
+                            "keeping previous policy (fail-closed)", self._policy_path,
+                        )
+                        if not self._merged_doc:
+                            # nothing verified has EVER been installed:
+                            # deny-all until a signed policy arrives
+                            doc = {
+                                "rules": [{
+                                    "id": "unverified-policy-deny-all",
+                                    "match": {},
+                                    "decision": "deny",
+                                    "reason": "policy signature unverified (fail-closed)",
+                                }]
+                            }
+                            raw = None
+                        else:
+                            return self._snapshot_id
+                if raw is not None:
+                    doc = yaml.safe_load(raw) or {}
             except FileNotFoundError:
                 pass
         rules = list(doc.get("rules") or [])

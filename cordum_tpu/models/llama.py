@@ -45,6 +45,9 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
+    # long-context: ring attention over `sp` (K/V rotate via ppermute, no
+    # device ever holds the full sequence) instead of the KV all-gather
+    use_ring_attention: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -171,7 +174,7 @@ def _attention(q, k, v, cfg: LlamaConfig, *, causal: bool = True, q_offset=None)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block(x, layer, cfg: LlamaConfig, positions, constrain):
+def _block(x, layer, cfg: LlamaConfig, positions, constrain, mesh=None):
     b, t, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -181,14 +184,19 @@ def _block(x, layer, cfg: LlamaConfig, positions, constrain):
     v = (attn_in @ layer["wv"]).reshape(b, t, kvh, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    # context parallelism (all-gather flavor): Q stays sequence-sharded over
-    # `sp`; K/V are constrained to full sequence, so GSPMD inserts the
-    # all-gather over the sp axis (SURVEY: "ring attention OR all-to-all
-    # sequence parallelism"; the ring variant lives in ops/ring_attention.py)
-    k = constrain(k, P(AXIS_DP, None, None, None))
-    v = constrain(v, P(AXIS_DP, None, None, None))
-    q_offset = positions  # absolute positions make causality correct under sp sharding
-    attn = _attention(q, k, v, cfg, q_offset=q_offset)
+    if cfg.use_ring_attention and mesh is not None and mesh.shape.get(AXIS_SP, 1) > 1:
+        # ring flavor: K/V never materialize the full sequence anywhere —
+        # chunks rotate the sp ring with an online softmax (long contexts)
+        from ..ops.ring_attention import ring_attention
+
+        attn = ring_attention(q, k, v, mesh)
+    else:
+        # context parallelism (all-gather flavor): Q stays sequence-sharded
+        # over `sp`; K/V are constrained to full sequence, so GSPMD inserts
+        # the all-gather over the sp axis
+        k = constrain(k, P(AXIS_DP, None, None, None))
+        v = constrain(v, P(AXIS_DP, None, None, None))
+        attn = _attention(q, k, v, cfg, q_offset=positions)
     x = x + (attn.reshape(b, t, h * hd) @ layer["wo"])
     x = constrain(x, P(AXIS_DP, AXIS_SP, None))
 
@@ -221,7 +229,7 @@ def forward(
     x = params["embed"][tokens]  # gather; embed sharded over tp on vocab dim
     x = constrain(x, P(AXIS_DP, AXIS_SP, None))
     for layer in params["layers"]:
-        x = _block(x, layer, cfg, positions, constrain)
+        x = _block(x, layer, cfg, positions, constrain, mesh=mesh)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return x @ params["lm_head"]
 

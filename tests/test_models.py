@@ -73,6 +73,24 @@ def test_llama_sharded_forward_matches_single_device():
     assert agree > 0.98, f"argmax agreement {agree}"
 
 
+def test_llama_ring_attention_matches_gather_flavor():
+    """use_ring_attention must produce the same logits as the KV-gather CP."""
+    import dataclasses
+
+    cfg = llama.LlamaConfig(vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+                            n_kv_heads=2, d_ff=128, dtype=jnp.float32)
+    ring_cfg = dataclasses.replace(cfg, use_ring_attention=True)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+    mesh = meshlib.build_mesh(meshlib.MeshSpec(dp=2, tp=1, sp=4))
+    sparams = llama.shard_params(params, cfg, mesh)
+    gather = jax.jit(lambda p, t: llama.forward(p, t, cfg, mesh=mesh))(sparams, tokens)
+    ring = jax.jit(lambda p, t: llama.forward(p, t, ring_cfg, mesh=mesh))(sparams, tokens)
+    np.testing.assert_allclose(
+        np.asarray(ring, np.float32), np.asarray(gather, np.float32), atol=1e-3, rtol=1e-3
+    )
+
+
 def test_llama_train_step_runs_sharded():
     cfg = llama.LlamaConfig.tiny()
     mesh = meshlib.build_mesh(meshlib.MeshSpec(dp=2, tp=2, sp=2))
