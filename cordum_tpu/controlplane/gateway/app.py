@@ -272,7 +272,7 @@ class Gateway:
             return
         event = json.dumps({"subject": subject, "packet": pkt.to_dict()}, default=str)
         dead = []
-        for ws in self._ws_clients:
+        for ws in list(self._ws_clients):  # set mutates during awaits
             try:
                 await ws.send_str(event)
             except Exception:
@@ -569,8 +569,23 @@ class Gateway:
             return _err(404, "unknown run")
         return web.json_response(run.to_dict())
 
+    async def _with_run_lock(self, run_id: str, fn):
+        """Run mutations must hold the same per-run lock the workflow-engine
+        service uses, or concurrent result handling loses updates."""
+        for _ in range(40):  # ~2s of 50ms retries before giving up
+            if await self.wf_engine.store.acquire_run_lock(run_id, self.instance_id):
+                try:
+                    return await fn()
+                finally:
+                    await self.wf_engine.store.release_run_lock(run_id, self.instance_id)
+            await asyncio.sleep(0.05)
+        raise web.HTTPConflict(reason=f"run {run_id} is busy; retry")
+
     async def cancel_run(self, request: web.Request) -> web.Response:
-        run = await self.wf_engine.cancel_run(request.match_info["run_id"], reason="api cancel")
+        run_id = request.match_info["run_id"]
+        run = await self._with_run_lock(
+            run_id, lambda: self.wf_engine.cancel_run(run_id, reason="api cancel")
+        )
         return web.json_response({"run_id": run.run_id, "status": run.status})
 
     async def rerun(self, request: web.Request) -> web.Response:
@@ -579,8 +594,12 @@ class Gateway:
         step_id = str(body.get("from_step", ""))
         if not step_id:
             return _err(400, "from_step is required")
-        run = await self.wf_engine.rerun_from(
-            request.match_info["run_id"], step_id, dry_run=bool(body.get("dry_run", False))
+        run_id = request.match_info["run_id"]
+        run = await self._with_run_lock(
+            run_id,
+            lambda: self.wf_engine.rerun_from(
+                run_id, step_id, dry_run=bool(body.get("dry_run", False))
+            ),
         )
         return web.json_response({"run_id": run.run_id, "status": run.status}, status=202)
 
@@ -590,11 +609,15 @@ class Gateway:
             return _err(403, "step approvals require the admin role")
         body = await request.json() if request.can_read_body else {}
         body = body or {}
-        run = await self.wf_engine.approve_step(
-            request.match_info["run_id"],
-            request.match_info["step_id"],
-            approve=bool(body.get("approve", True)),
-            approved_by=principal.principal_id,
+        run_id = request.match_info["run_id"]
+        run = await self._with_run_lock(
+            run_id,
+            lambda: self.wf_engine.approve_step(
+                run_id,
+                request.match_info["step_id"],
+                approve=bool(body.get("approve", True)),
+                approved_by=principal.principal_id,
+            ),
         )
         return web.json_response({"run_id": run.run_id, "status": run.status})
 

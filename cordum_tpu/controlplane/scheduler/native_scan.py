@@ -11,17 +11,24 @@ requirements).
 from __future__ import annotations
 
 import ctypes
+import time
 from typing import Optional
 
 from ...infra.registry import WorkerRegistry
 from ...native import load_strategy_scan
 from .strategy import _parse_tpu_requires
 
+REBUILD_INTERVAL_S = 1.0  # also time-bounded: TTL-expired workers must drop
+                          # from the pack even when no heartbeat mutates the
+                          # registry version (dead-worker case)
+
 
 class PackedWorkers:
     def __init__(self, registry: WorkerRegistry):
         self.registry = registry
         self._built_version = -1
+        self._built_at = 0.0
+        self._degenerate = False  # >64 distinct capabilities → python only
         self._lib = load_strategy_scan()
         self._cap_ids: dict[str, int] = {}
         self._pool_ids: dict[str, int] = {"": 0}
@@ -71,7 +78,9 @@ class PackedWorkers:
             for cap in hb.capabilities:
                 b = self._cap_bit(cap)
                 if b is None:
-                    bits = (1 << 64) - 1  # degenerate; python path will handle
+                    # capability space exhausted: the C scan can no longer
+                    # model eligibility — disable the native path entirely
+                    self._degenerate = True
                     break
                 bits |= 1 << b
             self._cap_bits[i] = bits
@@ -95,10 +104,17 @@ class PackedWorkers:
     ) -> Optional[str]:
         """Returns the chosen worker id, None for no-eligible-worker, or
         raises LookupError when this request can't use the native path."""
-        if self._lib is None:
+        if self._lib is None or self._degenerate:
             raise LookupError("native scan unavailable")
-        if self._built_version != self.registry.version:
+        now = time.monotonic()
+        if (
+            self._built_version != self.registry.version
+            or now - self._built_at > REBUILD_INTERVAL_S
+        ):
             self._rebuild()
+            self._built_at = now
+            if self._degenerate:
+                raise LookupError("capability space exhausted")
         if self.n == 0:
             return None
         req_caps = 0

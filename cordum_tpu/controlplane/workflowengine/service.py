@@ -106,6 +106,9 @@ class WorkflowEngineService:
                         progressed += 1
                     if self.job_store is not None:
                         progressed += await self._replay_terminal_jobs(run_id)
+                except Exception:
+                    # one poisoned run must not starve the rest of the pass
+                    logx.error("reconcile failed for run", run_id=run_id)
                 finally:
                     await self.engine.store.release_run_lock(run_id, self.instance_id)
         return progressed

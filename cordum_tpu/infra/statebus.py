@@ -81,6 +81,7 @@ class StateBusServer:
         self.kv = MemoryKV()
         self.aof_path = aof_path
         self._aof = None
+        self._last_fsync = 0.0
         self._server: Optional[asyncio.base_events.Server] = None
         # sid → (writer, pattern, queue)
         self._subs: dict[int, tuple[asyncio.StreamWriter, str, Optional[str]]] = {}
@@ -130,6 +131,13 @@ class StateBusServer:
     def _log_aof(self, op: str, args: tuple) -> None:
         if self._aof is not None:
             self._aof.write(msgpack.packb([op, *args], use_bin_type=True))
+            # flush before the op is acked: process-crash durability (an
+            # fsync interval below bounds power-loss exposure)
+            self._aof.flush()
+            now = time.monotonic()
+            if now - self._last_fsync > 0.2:
+                os.fsync(self._aof.fileno())
+                self._last_fsync = now
 
     # -- connection handling -------------------------------------------
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -278,7 +286,7 @@ class StateBusConn:
                 fut.set_exception(ConnectionError("statebus connection lost"))
         self._pending.clear()
 
-    async def call(self, op: str, *args: Any) -> Any:
+    async def call(self, op: str, *args: Any, timeout_s: float = 15.0) -> Any:
         if self._closed:
             raise ConnectionError("statebus connection closed")
         req_id = next(self._req_id)
@@ -287,7 +295,13 @@ class StateBusConn:
         async with self._lock:
             self._writer.write(_encode([req_id, op, *args]))
             await self._writer.drain()
-        return await fut
+        try:
+            # bounded wait: a half-open TCP connection (host died without
+            # FIN/RST) must surface as an error, not wedge the service
+            return await asyncio.wait_for(fut, timeout_s)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            raise ConnectionError(f"statebus call {op!r} timed out after {timeout_s}s")
 
 
 def _maybe_bytes(v: Any) -> Any:

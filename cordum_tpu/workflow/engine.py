@@ -127,12 +127,6 @@ class Engine:
                     f"org {org_id} at max concurrent runs ({max_concurrent_runs})"
                 )
         run_id = new_id()
-        if idempotency_key:
-            fresh, existing = await self.store.try_set_run_idempotency(idempotency_key, run_id)
-            if not fresh:
-                run = await self.store.get_run(existing)
-                if run is not None:
-                    return run
         run = WorkflowRun(
             run_id=run_id,
             workflow_id=workflow_id,
@@ -145,6 +139,16 @@ class Engine:
             dry_run=dry_run,
             labels=labels or {},
         )
+        if idempotency_key:
+            # persist the run shell BEFORE claiming the key: the loser of the
+            # setnx race must always be able to read the winner's run
+            await self.store.put_run(run)
+            fresh, existing = await self.store.try_set_run_idempotency(idempotency_key, run_id)
+            if not fresh:
+                await self.store.delete_run(run_id)
+                winner = await self.store.get_run(existing)
+                if winner is not None:
+                    return winner
         await self._timeline(run, "", "run_started", workflow_id)
         await self.schedule_ready(run, wf)
         await self._rollup_and_save(run, wf)
@@ -203,7 +207,9 @@ class Engine:
         while progress:
             progress = False
             for sid, step in wf.steps.items():
-                sr = run.steps[sid]
+                sr = run.steps.get(sid)
+                if sr is None:
+                    continue  # definition gained a step after this run started
                 if sr.status != M.PENDING:
                     # for_each parents may need more children dispatched
                     if sr.status == M.RUNNING and step.for_each:
@@ -685,7 +691,9 @@ class Engine:
         now = now_us()
         progressed = False
         for sid, sr in run.steps.items():
-            step = wf.steps[sid]
+            step = wf.steps.get(sid)
+            if step is None:
+                continue  # definition lost this step after the run started
             targets = [sr, *sr.children.values()]
             for t in targets:
                 if t.status != M.WAITING:
