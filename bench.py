@@ -108,12 +108,17 @@ def bench_embeds() -> dict:
 
         from cordum_tpu.models.embedder import Embedder, EmbedderConfig
 
-        cfg = EmbedderConfig()
+        on_accelerator = jax.devices()[0].platform not in ("cpu",)
+        if on_accelerator:
+            cfg = EmbedderConfig()
+            batch, iters = 256, 4
+        else:  # CPU smoke shape (single-core CI boxes)
+            cfg = EmbedderConfig(n_layers=2, d_model=128, max_len=64)
+            batch, iters = 32, 2
         e = Embedder(cfg, seed=0)
-        texts = [f"document {i}: control plane scheduling latency report" for i in range(256)]
-        e.embed(texts[:8])  # warm compile
+        texts = [f"document {i}: control plane scheduling latency report" for i in range(batch)]
+        e.embed(texts)  # warm compile
         t0 = time.perf_counter()
-        iters = 4
         for _ in range(iters):
             e.embed(texts)
         dt = time.perf_counter() - t0

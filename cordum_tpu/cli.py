@@ -103,6 +103,11 @@ SERVICES = [
 ]
 
 
+def _force_cpu_env() -> None:
+    os.environ["CORDUM_FORCE_CPU"] = "1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
 def cmd_up(args) -> None:
     """Bring up the local stack as subprocesses (reference `cordumctl up`)."""
     procs = []
@@ -263,6 +268,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--logdir", default=".cordum-logs")
     sp.add_argument("services", nargs="*", help="subset of services to start")
     sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("dev", help="alias for `up` with CPU-forced workers")
+    sp.add_argument("--logdir", default=".cordum-logs")
+    sp.add_argument("services", nargs="*")
+    sp.set_defaults(fn=lambda a: (_force_cpu_env(), cmd_up(a)))
 
     sp = sub.add_parser("status", help="gateway status")
     sp.set_defaults(fn=cmd_status)

@@ -19,6 +19,7 @@ TPU-native deltas (the north star's in-tree TPU worker):
 from __future__ import annotations
 
 import asyncio
+import itertools
 import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
@@ -96,6 +97,11 @@ class Worker:
         self._default_handler: Optional[Handler] = None
         self._sem = asyncio.Semaphore(max_parallel_jobs)
         self._active: dict[str, JobContext] = {}
+        # published-result cache: a redelivered job republishes its recorded
+        # result instead of re-running the work (reference worker behavior
+        # under at-least-once delivery, docs/AGENT_PROTOCOL.md)
+        self._completed: dict[str, JobResult] = {}
+        self._completed_cap = 512
         self._subs: list = []
         self._hb_task: Optional[asyncio.Task] = None
         self._executor = ThreadPoolExecutor(max_workers=max_parallel_jobs, thread_name_prefix=f"{worker_id}-jax")
@@ -156,6 +162,17 @@ class Worker:
     async def _run_job(self, req: JobRequest, *, trace_id: str = "") -> None:
         if req.job_id in self._active:
             return  # redelivery of an in-flight job
+        cached = self._completed.get(req.job_id)
+        if cached is not None:
+            # already ran: republish the recorded result, don't redo the work;
+            # fresh bus msg-id so the republish survives the dedupe window
+            copy = JobResult.from_dict(cached.to_dict())
+            copy.labels = dict(copy.labels or {})
+            copy.labels["cordum.bus_msg_id"] = f"republish-{req.job_id}-{time.monotonic_ns()}"
+            await self.bus.publish(
+                subj.RESULT, BusPacket.wrap(copy, trace_id=trace_id, sender_id=self.worker_id)
+            )
+            return
         payload = None
         if req.context_ptr:
             payload = await self.store.get_pointer(req.context_ptr)
@@ -195,6 +212,10 @@ class Worker:
             error_code=error_code,
             error_message=error_message,
         )
+        self._completed[req.job_id] = res
+        if len(self._completed) > self._completed_cap:
+            for k in list(itertools.islice(self._completed, self._completed_cap // 2)):
+                del self._completed[k]
         await self.bus.publish(subj.RESULT, BusPacket.wrap(res, trace_id=trace_id, sender_id=self.worker_id))
 
     # ------------------------------------------------------------------
