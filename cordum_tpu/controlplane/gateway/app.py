@@ -166,6 +166,10 @@ class Gateway:
         r.add_get(f"{v1}/packs", self.list_packs)
         r.add_get(f"{v1}/packs/{{pack_id}}", self.show_pack)
         r.add_delete(f"{v1}/packs/{{pack_id}}", self.uninstall_pack)
+        r.add_get(f"{v1}/pack-catalogs", self.list_catalogs)
+        r.add_post(f"{v1}/pack-catalogs", self.add_catalog)
+        r.add_get(f"{v1}/pack-catalogs/{{catalog}}/packs", self.catalog_packs)
+        r.add_post(f"{v1}/pack-catalogs/{{catalog}}/install/{{pack_id}}", self.catalog_install)
         r.add_get(f"{v1}/config/effective", self.config_effective)
         r.add_get(f"{v1}/config/{{scope}}/{{doc_id:.+}}", self.config_get)
         r.add_put(f"{v1}/config/{{scope}}/{{doc_id:.+}}", self.config_set)
@@ -705,6 +709,56 @@ class Gateway:
     async def policy_snapshots(self, request: web.Request) -> web.Response:
         return web.json_response({"snapshots": self.kernel.list_snapshots(),
                                   "current": self.kernel.snapshot_id})
+
+    # ------------------------------------------------------------------
+    # pack catalogs (local-directory marketplace equivalent)
+    # ------------------------------------------------------------------
+    def _catalog(self):
+        from ...packs import PackCatalog
+
+        return PackCatalog(self.configsvc, self._pack_installer())
+
+    async def list_catalogs(self, request: web.Request) -> web.Response:
+        return web.json_response({"catalogs": await self._catalog().list_catalogs()})
+
+    async def add_catalog(self, request: web.Request) -> web.Response:
+        from ...packs import PackError
+
+        if (deny := self._require_admin(request)) is not None:
+            return deny
+        body = await request.json()
+        try:
+            cat = self._catalog()
+            if body.get("allowed_roots") is not None:
+                await cat.set_allowed_roots(list(body["allowed_roots"]))
+            entry = None
+            if body.get("name") and body.get("path"):
+                entry = await cat.add_catalog(str(body["name"]), str(body["path"]))
+        except PackError as e:
+            return _err(400, str(e))
+        return web.json_response({"added": entry}, status=201)
+
+    async def catalog_packs(self, request: web.Request) -> web.Response:
+        from ...packs import PackError
+
+        try:
+            packs = await self._catalog().list_packs(request.match_info["catalog"])
+        except PackError as e:
+            return _err(404, str(e))
+        return web.json_response({"packs": packs})
+
+    async def catalog_install(self, request: web.Request) -> web.Response:
+        from ...packs import PackError
+
+        if (deny := self._require_admin(request)) is not None:
+            return deny
+        try:
+            record = await self._catalog().install_from_catalog(
+                request.match_info["catalog"], request.match_info["pack_id"]
+            )
+        except PackError as e:
+            return _err(400, str(e))
+        return web.json_response(record, status=201)
 
     # ------------------------------------------------------------------
     # policy bundles (reference policy_bundles.go)

@@ -101,6 +101,9 @@ class WorkerSnapshotWriter:
         self._task: Optional[asyncio.Task] = None
 
     async def write_once(self) -> None:
+        dead = self.registry.expire()  # TTL expiry loop (registry_memory.go:24)
+        if dead:
+            logx.info("workers expired", workers=",".join(dead))
         snap = self.registry.snapshot_json()
         await self.kv.set("sys:workers:snapshot", json.dumps(snap).encode())
 

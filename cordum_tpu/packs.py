@@ -310,6 +310,85 @@ class PackInstaller:
         return True
 
 
+# ---------------------------------------------------------------- catalogs
+
+
+CATALOGS_DOC_ID = "pack_catalogs"  # cfg:system:pack_catalogs
+
+
+class PackCatalog:
+    """Pack catalogs: named collections of installable packs.
+
+    The reference's marketplace catalogs fetch from allowed HTTP hosts
+    (packs.go:933-1368); this deployment is network-isolated, so catalogs
+    are *local directories* gated by an allowed-roots list stored alongside
+    them — the same trust boundary (admins control which sources installs
+    may come from), without the egress.
+    """
+
+    def __init__(self, configsvc: ConfigService, installer: PackInstaller):
+        self.configsvc = configsvc
+        self.installer = installer
+
+    async def _doc(self) -> dict:
+        doc = await self.configsvc.get("system", CATALOGS_DOC_ID)
+        return dict(doc.data) if doc else {"catalogs": {}, "allowed_roots": []}
+
+    async def add_catalog(self, name: str, path: str) -> dict:
+        data = await self._doc()
+        root = os.path.abspath(path)
+        allowed = data.get("allowed_roots") or []
+        if allowed and not any(root.startswith(os.path.abspath(a)) for a in allowed):
+            raise PackError(f"catalog path {root} outside allowed roots {allowed}")
+        if not os.path.isdir(root):
+            raise PackError(f"catalog path {root} is not a directory")
+        data.setdefault("catalogs", {})[name] = {"path": root}
+        await self.configsvc.set("system", CATALOGS_DOC_ID, data)
+        return data["catalogs"][name]
+
+    async def set_allowed_roots(self, roots: list[str]) -> None:
+        data = await self._doc()
+        data["allowed_roots"] = [os.path.abspath(r) for r in roots]
+        await self.configsvc.set("system", CATALOGS_DOC_ID, data)
+
+    async def list_catalogs(self) -> dict:
+        return (await self._doc()).get("catalogs", {})
+
+    async def list_packs(self, catalog: str) -> list[dict]:
+        catalogs = await self.list_catalogs()
+        entry = catalogs.get(catalog)
+        if entry is None:
+            raise PackError(f"unknown catalog {catalog!r}")
+        out = []
+        for child in sorted(os.listdir(entry["path"])):
+            pdir = os.path.join(entry["path"], child)
+            if os.path.exists(os.path.join(pdir, "pack.yaml")):
+                try:
+                    m = load_pack_dir(pdir)
+                    out.append({"id": m.id, "version": m.version, "name": m.name,
+                                "description": m.description})
+                except PackError:
+                    continue
+        return out
+
+    async def install_from_catalog(self, catalog: str, pack_id: str) -> dict:
+        catalogs = await self.list_catalogs()
+        entry = catalogs.get(catalog)
+        if entry is None:
+            raise PackError(f"unknown catalog {catalog!r}")
+        for child in sorted(os.listdir(entry["path"])):
+            pdir = os.path.join(entry["path"], child)
+            if not os.path.exists(os.path.join(pdir, "pack.yaml")):
+                continue
+            try:
+                m = load_pack_dir(pdir)
+            except PackError:
+                continue
+            if m.id == pack_id:
+                return await self.installer.install(m)
+        raise PackError(f"pack {pack_id!r} not found in catalog {catalog!r}")
+
+
 # ---------------------------------------------------------------- CLI glue
 
 

@@ -99,6 +99,52 @@ async def test_install_invalid_workflow_rejected(kv):
         await installer.install(manifest_from_doc(doc))
 
 
+async def test_pack_catalogs(kv, tmp_path):
+    import os
+
+    from cordum_tpu.packs import PackCatalog, PackError
+
+    installer, cs, kernel = make_installer(kv)
+    await kernel.reload()
+    cat = PackCatalog(cs, installer)
+    # allowed-roots gating
+    await cat.set_allowed_roots([str(tmp_path)])
+    with pytest.raises(PackError, match="outside allowed roots"):
+        await cat.add_catalog("bad", REPO + "/examples")
+    # build a local catalog inside the allowed root
+    import shutil
+
+    shutil.copytree(f"{REPO}/examples/hello-pack", str(tmp_path / "hello-pack"))
+    await cat.add_catalog("local", str(tmp_path))
+    packs = await cat.list_packs("local")
+    assert packs and packs[0]["id"] == "hello-pack"
+    record = await cat.install_from_catalog("local", "hello-pack")
+    assert "hello-pack-echo" in record["workflows"]
+    with pytest.raises(PackError, match="not found"):
+        await cat.install_from_catalog("local", "nope")
+
+
+async def test_pack_catalog_http(tmp_path):
+    import shutil
+
+    from tests.test_gateway import GwStack
+
+    shutil.copytree(f"{REPO}/examples/hello-pack", str(tmp_path / "hello-pack"))
+    async with GwStack() as s:
+        r = await s.client.post("/api/v1/pack-catalogs",
+                                json={"name": "local", "path": str(tmp_path),
+                                      "allowed_roots": [str(tmp_path)]},
+                                headers=s.h(admin=True))
+        assert r.status == 201
+        r = await s.client.get("/api/v1/pack-catalogs/local/packs", headers=s.h())
+        assert (await r.json())["packs"][0]["id"] == "hello-pack"
+        r = await s.client.post("/api/v1/pack-catalogs/local/install/hello-pack",
+                                headers=s.h(admin=True))
+        assert r.status == 201
+        r = await s.client.get("/api/v1/packs", headers=s.h())
+        assert "hello-pack" in (await r.json())["packs"]
+
+
 async def test_pack_http_endpoints():
     from tests.test_gateway import GwStack
 
