@@ -210,11 +210,20 @@ class Engine:
         if decision == Decision.ALLOW_WITH_CONSTRAINTS.value and resp.constraints:
             self._apply_constraints(req, resp.constraints)
 
-        # tenant concurrency
-        if self.tenant_concurrency_limit and req.tenant_id:
+        # tenant concurrency: per-tenant limit from the org-scoped effective
+        # config (rate_limits.concurrent_jobs), else the global default
+        limit = self.tenant_concurrency_limit
+        eff_raw = (req.env or {}).get(ENV_EFFECTIVE_CONFIG)
+        if eff_raw and req.tenant_id:
+            try:
+                rate = (json.loads(eff_raw).get("rate_limits") or {})
+                limit = int(rate.get("concurrent_jobs", limit) or limit)
+            except (ValueError, TypeError):
+                pass
+        if limit and req.tenant_id:
             active = await self.job_store.tenant_active_count(req.tenant_id)
-            if active >= self.tenant_concurrency_limit:
-                raise RetryAfter(0.25, f"tenant {req.tenant_id} at concurrency limit")
+            if active >= limit:
+                raise RetryAfter(0.25, f"tenant {req.tenant_id} at concurrency limit {limit}")
         if req.tenant_id:
             await self.job_store.tenant_active_add(req.tenant_id, req.job_id)
 

@@ -244,6 +244,22 @@ async def test_engine_tenant_concurrency_limit():
         await eng.handle_job_request(JobRequest(job_id="j2", topic="job.default", tenant_id="t"))
 
 
+async def test_engine_per_tenant_concurrency_from_effective_config(kv):
+    """An org-scoped rate_limits.concurrent_jobs bounds that tenant only."""
+    from cordum_tpu.infra.bus import RetryAfter
+
+    cs = ConfigService(kv)
+    await cs.set("org", "tight", {"rate_limits": {"concurrent_jobs": 1}})
+    eng, bus, js, _, reg = make_engine(configsvc=cs)
+    reg.update(hb("w1"))
+    await eng.handle_job_request(JobRequest(job_id="j1", topic="job.default", tenant_id="tight"))
+    with pytest.raises(RetryAfter):
+        await eng.handle_job_request(JobRequest(job_id="j2", topic="job.default", tenant_id="tight"))
+    # other tenants are unaffected
+    await eng.handle_job_request(JobRequest(job_id="j3", topic="job.default", tenant_id="loose"))
+    assert await js.get_state("j3") == "RUNNING"
+
+
 async def test_engine_heartbeat_updates_registry():
     eng, bus, js, kv, reg = make_engine()
     await eng.start()
