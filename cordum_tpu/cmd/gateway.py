@@ -34,11 +34,21 @@ async def main() -> None:
     wf_engine = WorkflowEngine(store=wf_store, bus=bus, mem=mem, schemas=schemas,
                                configsvc=configsvc, instance_id="gateway-wf")
     admin_keys = [k for k in os.environ.get("CORDUM_ADMIN_KEYS", "").split(",") if k]
+    # CORDUM_KEY_TENANTS="key1:tenantA,key2:tenantB" scopes keys to tenants
+    key_tenants: dict[str, str] = {}
+    for pair in os.environ.get("CORDUM_KEY_TENANTS", "").split(","):
+        k, sep, t = pair.partition(":")
+        if sep and k and t:
+            key_tenants[k] = t
     gw = Gateway(
         kv=kv, bus=bus, job_store=JobStore(kv), mem=mem, kernel=kernel,
         wf_store=wf_store, wf_engine=wf_engine, schemas=schemas, configsvc=configsvc,
         registry=WorkerRegistry(), context_svc=ContextService(kv),
-        auth=BasicAuthProvider(cfg.api_keys, admin_keys=admin_keys),
+        auth=BasicAuthProvider(
+            cfg.api_keys, admin_keys=admin_keys,
+            default_tenant=os.environ.get("CORDUM_DEFAULT_TENANT", "default"),
+            key_tenants=key_tenants,
+        ),
         rate_rps=_boot.env_float("API_RATE_LIMIT_RPS", 0.0),
         max_concurrent_runs=_boot.env_int("MAX_CONCURRENT_RUNS", 0),
     )

@@ -298,6 +298,11 @@ class Gateway:
             return _err(400, "topic is required")
         payload = body.get("payload", body.get("context"))
         tenant = str(body.get("tenant_id") or principal.tenant_id)
+        if tenant != principal.tenant_id and not principal.key_admin:
+            # body tenant_id may not escape the key's tenant scope; gate on
+            # key-derived admin status, not the forgeable role header
+            # (reference RequireTenantAccess, basic_auth.go:100-122)
+            return _err(403, f"tenant {tenant!r} not permitted for this principal")
         job_id = str(body.get("job_id") or new_id())
 
         idem = str(body.get("idempotency_key") or request.headers.get("Idempotency-Key", ""))
@@ -552,10 +557,15 @@ class Gateway:
         wf_id = request.match_info["wf_id"]
         body = await request.json() if request.can_read_body else {}
         body = body or {}
+        org = str(body.get("org_id") or principal.tenant_id)
+        if org != principal.tenant_id and not principal.key_admin:
+            # body org_id may not escape the key's tenant scope (same class
+            # as the submit_job tenant guard)
+            return _err(403, f"org {org!r} not permitted for this principal")
         run = await self.wf_engine.start_run(
             wf_id,
             body.get("input"),
-            org_id=str(body.get("org_id") or principal.tenant_id),
+            org_id=org,
             idempotency_key=request.headers.get("Idempotency-Key", str(body.get("idempotency_key", ""))),
             dry_run=bool(body.get("dry_run", False)),
             labels={str(k): str(v) for k, v in (body.get("labels") or {}).items()},

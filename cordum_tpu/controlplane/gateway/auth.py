@@ -1,10 +1,12 @@
 """Gateway auth: pluggable provider, OSS flat API-key allowlist
 (reference ``core/controlplane/gateway/basic_auth.go`` + ``auth_provider.go``).
 
-The OSS provider trusts ``X-Principal-Id`` / ``X-Principal-Role`` headers
-once the API key checks out (single-tenant mode unless the key map assigns
-tenants).  Enterprise RBAC is explicitly out of scope (reference keeps it
-out-of-repo too).
+The OSS provider trusts ``X-Principal-Id`` once the API key checks out, but
+``X-Principal-Role`` may never ESCALATE a non-admin key to admin — admin
+status is key-derived (``admin_keys``), and tenant selection is bounded by
+the key's assigned tenant (reference ResolveTenant/RequireTenantAccess,
+``basic_auth.go:100-122``).  Enterprise RBAC is explicitly out of scope
+(reference keeps it out-of-repo too).
 """
 from __future__ import annotations
 
@@ -19,6 +21,10 @@ class Principal:
     role: str = "user"  # user | admin
     tenant_id: str = "default"
     authenticated: bool = False
+    # True only when the admin status is key-derived (or dev open mode) —
+    # never from the client-forgeable X-Principal-Role header. Use this for
+    # authorization decisions that cross trust boundaries (tenant escapes).
+    key_admin: bool = False
 
 
 class AuthProvider:
@@ -30,10 +36,15 @@ class BasicAuthProvider(AuthProvider):
     """Flat API-key allowlist; empty key list = open (dev mode)."""
 
     def __init__(self, api_keys: Optional[list[str]] = None, *, admin_keys: Optional[list[str]] = None,
-                 default_tenant: str = "default"):
+                 default_tenant: str = "default",
+                 key_tenants: Optional[dict[str, str]] = None):
         self.api_keys = set(api_keys or [])
         self.admin_keys = set(admin_keys or [])
         self.default_tenant = default_tenant
+        # key → tenant that key is scoped to (reference ResolveTenant /
+        # RequireTenantAccess, basic_auth.go:100-122): a keyholder may not
+        # pick an arbitrary tenant — only its assigned one (or the default).
+        self.key_tenants = dict(key_tenants or {})
 
     def authenticate(self, headers) -> Optional[Principal]:
         key = headers.get("X-Api-Key", "")
@@ -42,14 +53,22 @@ class BasicAuthProvider(AuthProvider):
             key = auth[len("Bearer "):]
         if self.api_keys and key not in self.api_keys and key not in self.admin_keys:
             return None
+        key_admin = (key in self.admin_keys) or not self.api_keys
         role = headers.get("X-Principal-Role", "")
         if key and key in self.admin_keys:
             role = role or "admin"
+        elif self.api_keys and role == "admin":
+            role = "user"  # header may not escalate a non-admin key
+        allowed_tenant = self.key_tenants.get(key, self.default_tenant)
+        requested = headers.get("X-Tenant-Id", "")
+        if requested and requested != allowed_tenant and not key_admin:
+            return None
         return Principal(
             principal_id=headers.get("X-Principal-Id", "anonymous"),
             role=role or "user",
-            tenant_id=headers.get("X-Tenant-Id", self.default_tenant),
+            tenant_id=requested or allowed_tenant,
             authenticated=bool(key) or not self.api_keys,
+            key_admin=key_admin,
         )
 
 

@@ -69,6 +69,9 @@ class SafetyKernel:
         self._snapshot_id = ""
         self._snapshots: list[Snapshot] = []
         self._merged_doc: dict = {}
+        # last file-level doc that passed signature verification (signed mode):
+        # reused when the file goes missing/tampered so fragments still merge
+        self._last_verified_doc: Optional[dict] = None
 
     # ------------------------------------------------------------------
     async def reload(self) -> str:
@@ -82,41 +85,50 @@ class SafetyKernel:
             try:
                 with open(self._policy_path, "rb") as f:
                     raw = f.read()
-                if self._public_key_path:
-                    ok = False
+            except FileNotFoundError:
+                raw = None
+            if self._public_key_path:
+                # Signed mode: a missing file fails closed exactly like a bad
+                # signature — deleting/mis-pathing the file must not silently
+                # disable enforcement. Both paths fall THROUGH to the fragment
+                # merge below so configsvc policy updates keep applying.
+                verified = False
+                if raw is not None:
                     try:
                         with open(self._policy_path + ".sig", "rb") as f:
                             sig = f.read()
                         with open(self._public_key_path, "rb") as f:
                             pub = f.read()
-                        ok = verify_signature(raw, sig, pub)
+                        verified = verify_signature(raw, sig, pub)
                     except FileNotFoundError:
-                        ok = False
-                    if not ok:
-                        import logging as _l
-
-                        _l.getLogger("cordum").error(
-                            "policy signature verification FAILED for %s; "
-                            "keeping previous policy (fail-closed)", self._policy_path,
-                        )
-                        if not self._merged_doc:
-                            # nothing verified has EVER been installed:
-                            # deny-all until a signed policy arrives
-                            doc = {
-                                "rules": [{
-                                    "id": "unverified-policy-deny-all",
-                                    "match": {},
-                                    "decision": "deny",
-                                    "reason": "policy signature unverified (fail-closed)",
-                                }]
-                            }
-                            raw = None
-                        else:
-                            return self._snapshot_id
-                if raw is not None:
+                        verified = False
+                if verified:
                     doc = yaml.safe_load(raw) or {}
-            except FileNotFoundError:
-                pass
+                    self._last_verified_doc = copy.deepcopy(doc)
+                else:
+                    import logging as _l
+
+                    _l.getLogger("cordum").error(
+                        "signed policy %s %s; fail-closed to %s",
+                        self._policy_path,
+                        "missing" if raw is None else "signature verification FAILED",
+                        "previous verified policy" if self._last_verified_doc else "deny-all",
+                    )
+                    if self._last_verified_doc is not None:
+                        doc = copy.deepcopy(self._last_verified_doc)
+                    else:
+                        # nothing verified has EVER been installed:
+                        # deny-all until a signed policy arrives
+                        doc = {
+                            "rules": [{
+                                "id": "unverified-policy-deny-all",
+                                "match": {},
+                                "decision": "deny",
+                                "reason": "policy signature unverified (fail-closed)",
+                            }]
+                        }
+            elif raw is not None:
+                doc = yaml.safe_load(raw) or {}
         rules = list(doc.get("rules") or [])
         if self._configsvc is not None:
             for frag_id in sorted(await self._configsvc.list("system")):

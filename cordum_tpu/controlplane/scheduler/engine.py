@@ -135,6 +135,16 @@ class Engine:
                 return  # idempotency short-circuit under redelivery
             self.metrics.jobs_received.inc(topic=req.topic)
             st = await self.job_store.get_state(req.job_id)
+            if st in (
+                JobState.SCHEDULED.value,
+                JobState.DISPATCHED.value,
+                JobState.RUNNING.value,
+            ):
+                # In-flight short-circuit: a redelivered submit for a job
+                # already dispatched must not re-run the safety check, burn an
+                # attempt, or attempt an illegal →SCHEDULED transition (enough
+                # duplicates could otherwise DLQ a job that is still running).
+                return
             if st == JobState.APPROVAL_REQUIRED.value:
                 # Parked jobs only move via a valid approval: the republish
                 # must carry the approval label AND hash-match the stored

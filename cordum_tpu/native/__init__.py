@@ -7,6 +7,7 @@ the framework works without a toolchain.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 from typing import Optional
@@ -15,17 +16,28 @@ from ..infra import logging as logx
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "strategy_scan.c")
-_LIB = os.path.join(_DIR, "libstrategy_scan.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _lib_path() -> str:
+    """Output path stamped with the source hash.
+
+    Binaries are never committed; the library is only loaded if its name
+    matches the current source's hash, so a stale artifact (from a previous
+    source revision) can never be silently loaded into the scheduler hot path.
+    """
+    with open(_SRC, "rb") as f:
+        h = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(_DIR, f"libstrategy_scan-{h}.so")
+
+
+def _build(out: str) -> bool:
     for cc in ("cc", "gcc", "clang"):
         try:
             subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+                [cc, "-O2", "-shared", "-fPIC", "-o", out, _SRC],
                 check=True, capture_output=True, timeout=60,
             )
             return True
@@ -41,11 +53,19 @@ def load_strategy_scan() -> Optional[ctypes.CDLL]:
         return _lib
     _tried = True
     try:
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            if not _build():
+        lib_file = _lib_path()
+        if not os.path.exists(lib_file):
+            import glob
+
+            for stale in glob.glob(os.path.join(_DIR, "libstrategy_scan-*.so")):
+                try:
+                    os.unlink(stale)  # drop artifacts of older source revisions
+                except OSError:
+                    pass
+            if not _build(lib_file):
                 logx.warn("native strategy scan unavailable (no C compiler)")
                 return None
-        lib = ctypes.CDLL(_LIB)
+        lib = ctypes.CDLL(lib_file)
         lib.pick_worker.restype = ctypes.c_int32
         lib.pick_worker.argtypes = [
             ctypes.c_int32,
@@ -65,7 +85,7 @@ def load_strategy_scan() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,                    # req_topology_id
         ]
         _lib = lib
-        logx.info("native strategy scan loaded", lib=_LIB)
+        logx.info("native strategy scan loaded", lib=lib_file)
     except OSError as e:
         logx.warn("native strategy scan failed to load", err=str(e))
         _lib = None

@@ -336,15 +336,26 @@ class PackCatalog:
 
     async def add_catalog(self, name: str, path: str) -> dict:
         data = await self._doc()
-        root = os.path.abspath(path)
+        # Resolve symlinks before the containment check: a plain prefix test
+        # would let /opt/packs-evil pass for allowed root /opt/packs, and a
+        # symlink inside an allowed root could escape it.
+        root = os.path.realpath(path)
         allowed = data.get("allowed_roots") or []
-        if allowed and not any(root.startswith(os.path.abspath(a)) for a in allowed):
+        if allowed and not any(self._contains(os.path.realpath(a), root) for a in allowed):
             raise PackError(f"catalog path {root} outside allowed roots {allowed}")
         if not os.path.isdir(root):
             raise PackError(f"catalog path {root} is not a directory")
         data.setdefault("catalogs", {})[name] = {"path": root}
         await self.configsvc.set("system", CATALOGS_DOC_ID, data)
         return data["catalogs"][name]
+
+    @staticmethod
+    def _contains(ancestor: str, path: str) -> bool:
+        """True iff ``path`` is ``ancestor`` or lies inside it (both resolved)."""
+        try:
+            return os.path.commonpath([ancestor, path]) == ancestor
+        except ValueError:  # different drives / mixed abs-rel
+            return False
 
     async def set_allowed_roots(self, roots: list[str]) -> None:
         data = await self._doc()

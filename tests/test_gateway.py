@@ -114,6 +114,48 @@ async def test_auth_required():
         assert r.status == 200
 
 
+async def test_tenant_scope_enforced():
+    """A keyholder may not pick an arbitrary tenant via header or body
+    (reference ResolveTenant/RequireTenantAccess, basic_auth.go:100-122)."""
+    async with GwStack() as s:
+        # header tenant outside the key's scope → auth rejected
+        r = await s.client.get("/api/v1/jobs", headers=s.h(**{"X-Tenant-Id": "other"}))
+        assert r.status == 401
+        # body tenant_id outside the principal's tenant → 403
+        r = await s.client.post(
+            "/api/v1/jobs",
+            json={"topic": "job.work", "tenant_id": "other"},
+            headers=s.h(),
+        )
+        assert r.status == 403
+        # admins may act across tenants; default tenant always fine
+        r = await s.client.post(
+            "/api/v1/jobs",
+            json={"topic": "job.work", "tenant_id": "other"},
+            headers=s.h(admin=True),
+        )
+        assert r.status == 202
+        r = await s.client.post(
+            "/api/v1/jobs",
+            json={"topic": "job.work", "tenant_id": "default"},
+            headers=s.h(),
+        )
+        assert r.status == 202
+
+
+def test_key_tenant_map_allows_assigned_tenant():
+    prov = BasicAuthProvider(
+        ["k1", "k2"], key_tenants={"k2": "acme"}, default_tenant="default"
+    )
+    # k2 is scoped to acme: may select it, lands in it by default assignment
+    p = prov.authenticate({"X-Api-Key": "k2", "X-Tenant-Id": "acme"})
+    assert p is not None and p.tenant_id == "acme"
+    assert prov.authenticate({"X-Api-Key": "k2"}).tenant_id == "acme"
+    # k1 has no assignment → cannot select acme
+    assert prov.authenticate({"X-Api-Key": "k1", "X-Tenant-Id": "acme"}) is None
+    assert prov.authenticate({"X-Api-Key": "k1"}).tenant_id == "default"
+
+
 async def test_job_submit_roundtrip():
     async with GwStack() as s:
         r = await s.client.post("/api/v1/jobs", json={"topic": "job.work", "payload": {"n": 1}},

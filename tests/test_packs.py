@@ -124,6 +124,34 @@ async def test_pack_catalogs(kv, tmp_path):
         await cat.install_from_catalog("local", "nope")
 
 
+async def test_pack_catalog_root_boundaries(kv, tmp_path):
+    """Prefix tricks and symlink escapes must not pass the allowed-roots gate
+    (advisor finding: plain startswith let /opt/packs-evil match /opt/packs)."""
+    import os
+
+    from cordum_tpu.packs import PackCatalog, PackError
+
+    installer, cs, kernel = make_installer(kv)
+    cat = PackCatalog(cs, installer)
+    good = tmp_path / "packs"
+    good.mkdir()
+    evil = tmp_path / "packs-evil"  # same string prefix, different dir
+    evil.mkdir()
+    outside = tmp_path / "outside"
+    outside.mkdir()
+    link = good / "escape"  # symlink inside the root pointing out of it
+    os.symlink(str(outside), str(link))
+    await cat.set_allowed_roots([str(good)])
+    with pytest.raises(PackError, match="outside allowed roots"):
+        await cat.add_catalog("evil", str(evil))
+    with pytest.raises(PackError, match="outside allowed roots"):
+        await cat.add_catalog("escape", str(link))
+    # the root itself and true subdirectories still pass
+    (good / "sub").mkdir()
+    await cat.add_catalog("root", str(good))
+    await cat.add_catalog("sub", str(good / "sub"))
+
+
 async def test_pack_catalog_http(tmp_path):
     import shutil
 

@@ -211,6 +211,26 @@ async def test_engine_terminal_short_circuit_on_redelivery():
     assert await js.get_state("j1") == "SUCCEEDED"
 
 
+async def test_engine_inflight_short_circuit_on_redelivery():
+    """A redelivered submit for a RUNNING job must not re-dispatch, re-check
+    safety, or burn dispatch attempts toward the DLQ (advisor finding)."""
+    eng, bus, js, kv, reg = make_engine()
+    reg.update(hb("w1"))
+    await eng.start()
+    req = JobRequest(job_id="j1", topic="job.default")
+    await eng.handle_job_request(req)
+    assert await js.get_state("j1") == "RUNNING"
+    n_published = len(bus.published)
+    attempts = (await js.get_meta("j1"))["attempts"]
+    for _ in range(10):  # more duplicates than max_attempts
+        await eng.handle_job_request(req)
+    assert len(bus.published) == n_published  # nothing re-dispatched
+    assert (await js.get_meta("j1"))["attempts"] == attempts
+    assert await js.get_state("j1") == "RUNNING"  # not DLQ'd/failed
+    await eng.handle_job_result(JobResult(job_id="j1", status="SUCCEEDED"))
+    assert await js.get_state("j1") == "SUCCEEDED"
+
+
 async def test_engine_failed_result_emits_dlq():
     eng, bus, js, kv, reg = make_engine()
     reg.update(hb("w1"))

@@ -81,6 +81,67 @@ async def test_kernel_missing_sig_rejected(tmp_path):
     assert resp.decision == "ALLOW"
 
 
+async def test_kernel_missing_policy_file_fails_closed(tmp_path):
+    """Deleting/mis-pathing a signed policy file must not disable enforcement
+    (advisor finding: the FileNotFoundError fallback previously reverted to
+    the unsigned in-memory doc → default allow)."""
+    priv, pub = make_keys()
+    ppath = tmp_path / "safety.yaml"
+    ppath.write_bytes(POLICY)
+    (tmp_path / "safety.yaml.sig").write_bytes(priv.sign(POLICY))
+    kpath = tmp_path / "policy.pub"
+    kpath.write_bytes(pub)
+    kernel = SafetyKernel(policy_path=str(ppath), public_key_path=str(kpath))
+    await kernel.reload()
+    snap = kernel.snapshot_id
+    # attacker deletes the policy file → previous verified policy is kept
+    ppath.unlink()
+    await kernel.reload()
+    assert kernel.snapshot_id == snap
+    resp = await kernel.evaluate_raw(PolicyCheckRequest(topic="other.x"))
+    assert resp.decision == "DENY"  # still the signed tenant allowlist
+
+    # pubkey configured but the policy file NEVER existed → deny-all sentinel
+    kernel2 = SafetyKernel(
+        policy_path=str(tmp_path / "nope.yaml"), public_key_path=str(kpath)
+    )
+    await kernel2.reload()
+    resp = await kernel2.evaluate_raw(PolicyCheckRequest(topic="job.x"))
+    assert resp.decision == "DENY"
+    assert "fail-closed" in resp.reason or "unverified" in resp.reason
+
+
+async def test_kernel_fragments_still_merge_while_file_missing(tmp_path, kv):
+    """Fail-closed on a missing signed file must NOT freeze the policy:
+    configsvc fragments pushed while the file is absent still apply."""
+    from cordum_tpu.infra.configsvc import ConfigService
+
+    priv, pub = make_keys()
+    ppath = tmp_path / "safety.yaml"
+    ppath.write_bytes(POLICY)
+    (tmp_path / "safety.yaml.sig").write_bytes(priv.sign(POLICY))
+    kpath = tmp_path / "policy.pub"
+    kpath.write_bytes(pub)
+    cs = ConfigService(kv)
+    kernel = SafetyKernel(
+        policy_path=str(ppath), public_key_path=str(kpath), configsvc=cs
+    )
+    await kernel.reload()
+    assert (await kernel.evaluate_raw(PolicyCheckRequest(topic="job.x"))).decision == "ALLOW"
+    ppath.unlink()
+    # admin pushes a deny fragment while the file is missing
+    await cs.set("system", "policy/deny-x", {
+        "enabled": True,
+        "rules": [{"id": "block-x", "match": {"topics": ["job.x"]}, "decision": "deny"}],
+    })
+    await kernel.reload()
+    resp = await kernel.evaluate_raw(PolicyCheckRequest(topic="job.x"))
+    assert resp.decision == "DENY"  # fragment merged despite missing file
+    # and the verified file policy is still enforced underneath
+    assert (await kernel.evaluate_raw(PolicyCheckRequest(topic="job.other"))).decision == "ALLOW"
+    assert (await kernel.evaluate_raw(PolicyCheckRequest(topic="nope.x"))).decision == "DENY"
+
+
 # ---------------------------------------------------------------- CLI
 
 def test_cli_parser_covers_commands():
