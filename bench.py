@@ -1,11 +1,21 @@
 """Headline benchmark: scheduled jobs/sec end-to-end through the control
 plane (BASELINE.json north star: ≥1,000 scheduled TPU jobs/sec on v5p-8).
 
-Drives the real pipeline — gateway-role submit → scheduler engine (safety
-check, strategy, state machine) → worker → result handling — over the
-in-process bus with the KV store, i.e. every control-plane code path a
-production deployment runs per job, minus network hops.  Also measures
-context-engine embeds/sec on the accelerator when one is available.
+Four benches, one JSON line:
+
+* ``scheduled_jobs_per_sec`` — burst submit through the real pipeline
+  (gateway-role submit → scheduler engine w/ safety check, strategy, state
+  machine → worker → result handling) over the in-process bus + KV store.
+* ``p50_e2e_ms``/``p99_e2e_ms`` — PACED open-loop submission at a fixed
+  offered rate with exact per-job submit→result timing (a burst benchmark
+  is queueing-dominated and says nothing about latency).
+* ``selections_per_sec`` — worker-selection throughput at 1000 workers
+  (reference analogue: 18,234/s, BENCHMARKS.md:131).
+* TPU compute: ``embeds_per_sec`` (context-engine embedder) and
+  ``model_tokens_per_sec``+``mfu`` (Llama forward).  These run in a
+  SUBPROCESS with a hard watchdog: a wedged TPU grant or a crashed PJRT
+  client must never take down the control-plane numbers, and any failure
+  is reported in ``embed_error``/``model_error`` — never swallowed.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
@@ -14,14 +24,21 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import subprocess
 import sys
 import time
 
 N_JOBS = int(os.environ.get("BENCH_JOBS", "3000"))
+PACED_JOBS = int(os.environ.get("BENCH_PACED_JOBS", "1500"))
+PACED_RATE = float(os.environ.get("BENCH_PACED_RATE", "1000"))  # jobs/s offered
+JAX_TIMEOUT_S = float(os.environ.get("BENCH_JAX_TIMEOUT_S", "420"))
 BASELINE_JOBS_PER_SEC = 1000.0  # BASELINE.json north-star target
 
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheets)
+PEAK_FLOPS = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "v6e": 918e12}
 
-async def bench_scheduler() -> dict:
+
+def _make_stack():
     from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
     from cordum_tpu.controlplane.scheduler.engine import Engine
     from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
@@ -30,15 +47,12 @@ async def bench_scheduler() -> dict:
     from cordum_tpu.infra.config import parse_pool_config
     from cordum_tpu.infra.jobstore import JobStore
     from cordum_tpu.infra.kv import MemoryKV
-    from cordum_tpu.infra.memstore import MemoryStore
     from cordum_tpu.infra.registry import WorkerRegistry
-    from cordum_tpu.protocol import subjects as subj
-    from cordum_tpu.protocol.types import BusPacket, Heartbeat, JobRequest, JobResult
+    from cordum_tpu.protocol.types import Heartbeat
 
     kv = MemoryKV()
     bus = LoopbackBus()
     js = JobStore(kv)
-    ms = MemoryStore(kv)
     kernel = SafetyKernel(
         policy_doc={
             "tenants": {"default": {"allow_topics": ["job.*", "job.>"]}},
@@ -53,14 +67,19 @@ async def bench_scheduler() -> dict:
         bus=bus, job_store=js, safety=SafetyClient(kernel.check),
         strategy=LeastLoadedStrategy(reg, pc), registry=reg,
     )
+    reg.update(Heartbeat(worker_id="bench-w", pool="bench", max_parallel_jobs=1 << 30))
+    return kv, bus, js, eng
+
+
+async def bench_scheduler() -> dict:
+    """Burst throughput: N_JOBS submitted as fast as possible."""
+    from cordum_tpu.protocol import subjects as subj
+    from cordum_tpu.protocol.types import BusPacket, JobRequest, JobResult
+
+    kv, bus, js, eng = _make_stack()
     await eng.start()
 
-    done = asyncio.Event()
-    completed = 0
-
-    # minimal worker: replies immediately (we are measuring the control plane)
     async def worker_handler(subject, pkt):
-        nonlocal completed
         req = pkt.job_request
         await bus.publish(
             subj.RESULT,
@@ -71,63 +90,98 @@ async def bench_scheduler() -> dict:
         )
 
     await bus.subscribe("worker.bench-w.jobs", worker_handler, queue="w")
-    for i in range(4):
-        reg.update(Heartbeat(worker_id="bench-w", pool="bench", max_parallel_jobs=1 << 30))
 
-    # count terminal results via the engine's completion metric
     t0 = time.perf_counter()
     for i in range(N_JOBS):
         req = JobRequest(job_id=f"bench-{i}", topic="job.bench", tenant_id="default")
         await bus.publish(subj.SUBMIT, BusPacket.wrap(req, sender_id="bench"))
     await bus.drain()
-    # wait for all results to land
     deadline = time.perf_counter() + 120
     while time.perf_counter() < deadline:
         await bus.drain()
-        n = eng.metrics.jobs_completed.value(status="SUCCEEDED")
-        if n >= N_JOBS:
+        if eng.metrics.jobs_completed.value(status="SUCCEEDED") >= N_JOBS:
             break
         await asyncio.sleep(0.01)
     dt = time.perf_counter() - t0
     n = eng.metrics.jobs_completed.value(status="SUCCEEDED")
-    p50 = eng.metrics.e2e_latency.quantile(0.5)
     await eng.stop()
     await bus.close()
-    return {
-        "jobs": int(n),
-        "seconds": dt,
-        "jobs_per_sec": n / dt if dt > 0 else 0.0,
-        "p50_e2e_ms": (p50 or 0.0) * 1000,
-    }
+    return {"jobs": int(n), "seconds": dt, "jobs_per_sec": n / dt if dt > 0 else 0.0}
 
 
-def bench_embeds() -> dict:
-    """Context-engine embedding throughput on the available accelerator."""
+async def bench_latency() -> dict:
+    """Open-loop paced submission at PACED_RATE jobs/s offered load, exact
+    submit→result latency per job (raw list, not a capped histogram)."""
+    from cordum_tpu.protocol import subjects as subj
+    from cordum_tpu.protocol.types import BusPacket, JobRequest, JobResult
+
+    kv, bus, js, eng = _make_stack()
+    await eng.start()
+
+    done: dict[str, float] = {}
+    submitted: dict[str, float] = {}
+    all_done = asyncio.Event()
+
+    async def worker_handler(subject, pkt):
+        req = pkt.job_request
+        await bus.publish(
+            subj.RESULT,
+            BusPacket.wrap(
+                JobResult(job_id=req.job_id, status="SUCCEEDED", worker_id="bench-w"),
+                sender_id="bench-w",
+            ),
+        )
+
+    async def result_tap(subject, pkt):
+        res = pkt.job_result
+        if res and res.job_id in submitted and res.job_id not in done:
+            done[res.job_id] = time.perf_counter() - submitted[res.job_id]
+            if len(done) >= PACED_JOBS:
+                all_done.set()
+
+    await bus.subscribe("worker.bench-w.jobs", worker_handler, queue="w")
+    await bus.subscribe(subj.RESULT, result_tap)
+
+    # pace in 10ms ticks to keep sleep() syscalls off the per-job path
+    tick = 0.010
+    per_tick = max(1, int(PACED_RATE * tick))
+    i = 0
+    start = time.perf_counter()
+    while i < PACED_JOBS:
+        tick_t0 = time.perf_counter()
+        for _ in range(min(per_tick, PACED_JOBS - i)):
+            jid = f"lat-{i}"
+            submitted[jid] = time.perf_counter()
+            await bus.publish(
+                subj.SUBMIT,
+                BusPacket.wrap(JobRequest(job_id=jid, topic="job.bench"), sender_id="bench"),
+            )
+            i += 1
+        # open loop: sleep the REMAINDER of the tick regardless of completions
+        rem = tick - (time.perf_counter() - tick_t0)
+        if rem > 0:
+            await asyncio.sleep(rem)
     try:
-        import jax
+        await asyncio.wait_for(all_done.wait(), timeout=60)
+    except asyncio.TimeoutError:
+        pass
+    offered_dt = time.perf_counter() - start
+    await eng.stop()
+    await bus.close()
+    lat = sorted(done.values())
+    if not lat:
+        return {"paced_completed": 0}
 
-        from cordum_tpu.models.embedder import Embedder, EmbedderConfig
+    def q(p: float) -> float:
+        return lat[min(len(lat) - 1, int(p * len(lat)))] * 1000
 
-        on_accelerator = jax.devices()[0].platform not in ("cpu",)
-        if on_accelerator:
-            cfg = EmbedderConfig()
-            batch, iters = 256, 4
-        else:  # CPU smoke shape (single-core CI boxes)
-            cfg = EmbedderConfig(n_layers=2, d_model=128, max_len=64)
-            batch, iters = 32, 2
-        e = Embedder(cfg, seed=0)
-        texts = [f"document {i}: control plane scheduling latency report" for i in range(batch)]
-        e.embed(texts)  # warm compile
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            e.embed(texts)
-        dt = time.perf_counter() - t0
-        return {
-            "embeds_per_sec": iters * len(texts) / dt,
-            "embed_device": jax.devices()[0].device_kind,
-        }
-    except Exception as ex:  # accelerator unavailable → report scheduling only
-        return {"embeds_per_sec": 0.0, "embed_error": str(ex)[:120]}
+    return {
+        "paced_completed": len(lat),
+        "paced_offered_rate": PACED_JOBS / offered_dt,
+        "p50_e2e_ms": q(0.50),
+        "p90_e2e_ms": q(0.90),
+        "p99_e2e_ms": q(0.99),
+    }
 
 
 def bench_selection() -> dict:
@@ -161,23 +215,173 @@ def bench_selection() -> dict:
     return {"selections_per_sec": n / dt, "native": strat._packed is not None}
 
 
+# ---------------------------------------------------------------------------
+# TPU compute benches — run via `python bench.py --jax-child [tpu|cpu]` in a
+# subprocess so a wedged TPU grant / crashed PJRT client can't hang the
+# control-plane benches. The child prints ONE json line.
+# ---------------------------------------------------------------------------
+
+
+def _jax_child(device: str) -> None:
+    import faulthandler
+
+    # watchdog: if the PJRT client wedges (e.g. TPU grant never arrives),
+    # die with a traceback instead of hanging the driver
+    faulthandler.dump_traceback_later(max(30.0, JAX_TIMEOUT_S - 30.0), exit=True)
+    if device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    out: dict = {}
+    import jax
+
+    if device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    out["device"] = dev.device_kind
+    peak = 0.0
+    for gen, flops in PEAK_FLOPS.items():
+        if gen in dev.device_kind.lower().replace(" ", ""):
+            peak = flops
+
+    # --- embedder (context-engine path; headline embeds/sec) ---
+    try:
+        from cordum_tpu.models.embedder import Embedder, EmbedderConfig
+
+        if device == "cpu":  # CPU smoke shape (single-core CI boxes)
+            cfg = EmbedderConfig(n_layers=2, d_model=128, max_len=64)
+            batch, iters = 32, 2
+        else:
+            cfg = EmbedderConfig()
+            batch, iters = 256, 8
+        e = Embedder(cfg, seed=0)
+        texts = [f"document {i}: control plane scheduling latency report" for i in range(batch)]
+        e.embed(texts)  # warm compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            e.embed(texts)
+        dt = time.perf_counter() - t0
+        out["embeds_per_sec"] = iters * batch / dt
+    except Exception as ex:  # noqa: BLE001
+        out["embed_error"] = f"{type(ex).__name__}: {ex}"[:300]
+
+    # --- llama forward (tokens/s + MFU) ---
+    try:
+        from cordum_tpu.models import llama
+
+        if device == "cpu":
+            cfg = llama.LlamaConfig(vocab_size=2048, d_model=128, n_layers=2,
+                                    n_heads=4, n_kv_heads=2, d_ff=384)
+            b, s, iters = 2, 128, 2
+        else:
+            # matmul-dominated shape that fits a single chip's HBM comfortably
+            cfg = llama.LlamaConfig(vocab_size=32000, d_model=2048, n_layers=16,
+                                    n_heads=16, n_kv_heads=8, d_ff=7168)
+            b, s, iters = 8, 1024, 6
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, cfg.vocab_size)
+        fwd = jax.jit(lambda p, t: llama.forward(p, t, cfg))
+        jax.block_until_ready(fwd(params, tokens))  # warm compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = fwd(params, tokens)
+        jax.block_until_ready(y)
+        dt = time.perf_counter() - t0
+        toks = b * s * iters
+        # analytic FLOPs: 2 flops/param/token over every dense matmul weight
+        # (embed lookup excluded, lm_head included) + attention score/value
+        # matmuls 2*2*S*h*hd per token per layer (causal → /2)
+        dense_params = sum(
+            x.size for x in jax.tree.leaves(params)
+            if hasattr(x, "ndim") and x.ndim == 2
+        ) - cfg.vocab_size * cfg.d_model  # embed table
+        attn = cfg.n_layers * 2 * 2 * s * cfg.n_heads * cfg.head_dim / 2
+        flops_per_tok = 2 * dense_params + attn
+        out["model_tokens_per_sec"] = toks / dt
+        out["model_params_m"] = round(
+            sum(x.size for x in jax.tree.leaves(params) if hasattr(x, "size")) / 1e6, 1)
+        out["model_achieved_tflops"] = toks * flops_per_tok / dt / 1e12
+        if peak:
+            out["mfu"] = round(toks * flops_per_tok / dt / peak, 4)
+    except Exception as ex:  # noqa: BLE001
+        out["model_error"] = f"{type(ex).__name__}: {ex}"[:300]
+
+    print(json.dumps(out), flush=True)
+
+
+def bench_jax() -> dict:
+    """Run the TPU bench child; fall back to a CPU child so the compute path
+    is still exercised when the TPU is unavailable (clearly labeled)."""
+    results: dict = {}
+    for device in ("tpu", "cpu"):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--jax-child", device],
+                capture_output=True, text=True, timeout=JAX_TIMEOUT_S,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            line = (proc.stdout.strip().splitlines() or [""])[-1]
+            child = json.loads(line) if line.startswith("{") else {}
+            if not child:
+                tail = (proc.stderr or proc.stdout or "")[-300:]
+                child = {"embed_error": f"child rc={proc.returncode}: {tail}",
+                         "model_error": f"child rc={proc.returncode}"}
+        except subprocess.TimeoutExpired:
+            child = {"embed_error": f"{device} bench timed out after {JAX_TIMEOUT_S}s "
+                                    "(TPU grant unavailable?)",
+                     "model_error": "timeout"}
+        except Exception as ex:  # noqa: BLE001
+            child = {"embed_error": f"{type(ex).__name__}: {ex}"[:300]}
+        if device == "tpu":
+            results = dict(child)
+            if "embeds_per_sec" in child and "model_tokens_per_sec" in child:
+                return results
+            # remember why the TPU pass failed, then try CPU for coverage;
+            # only backfill embed_error if the embed bench itself is missing
+            # (a model-only failure must not be misattributed)
+            if "embeds_per_sec" not in results and "embed_error" not in results:
+                results["embed_error"] = results.get("model_error", "unknown")
+        else:
+            # merge CPU numbers for whichever metric the TPU pass missed
+            for k in ("embeds_per_sec", "model_tokens_per_sec",
+                      "model_achieved_tflops", "model_params_m"):
+                if k not in results and k in child:
+                    results[k] = child[k]
+                    results["fallback_device"] = child.get("device", "cpu")
+    return results
+
+
 def main() -> None:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--jax-child":
+        _jax_child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
+        return
     sched = asyncio.run(bench_scheduler())
+    lat = asyncio.run(bench_latency())
     sel = bench_selection()
-    emb = bench_embeds()
+    jx = bench_jax()
     out = {
         "metric": "scheduled_jobs_per_sec",
         "value": round(sched["jobs_per_sec"], 1),
         "unit": "jobs/s",
         "vs_baseline": round(sched["jobs_per_sec"] / BASELINE_JOBS_PER_SEC, 3),
-        "p50_e2e_ms": round(sched["p50_e2e_ms"], 2),
         "jobs": sched["jobs"],
+        "p50_e2e_ms": round(lat.get("p50_e2e_ms", 0.0), 2),
+        "p99_e2e_ms": round(lat.get("p99_e2e_ms", 0.0), 2),
+        "paced_rate_offered": round(lat.get("paced_offered_rate", 0.0), 1),
+        "paced_completed": lat.get("paced_completed", 0),
         "selections_per_sec": round(sel["selections_per_sec"], 1),
         "native_scan": sel["native"],
-        "embeds_per_sec": round(emb.get("embeds_per_sec", 0.0), 1),
+        # TPU compute: always present, errors never swallowed
+        "embeds_per_sec": round(jx.get("embeds_per_sec", 0.0), 1),
+        "embed_error": jx.get("embed_error", ""),
+        "model_tokens_per_sec": round(jx.get("model_tokens_per_sec", 0.0), 1),
+        "model_error": jx.get("model_error", ""),
+        "mfu": jx.get("mfu", None),
+        "model_achieved_tflops": round(jx.get("model_achieved_tflops", 0.0), 2),
+        "embed_device": jx.get("device", ""),
     }
-    if "embed_device" in emb:
-        out["embed_device"] = emb["embed_device"]
+    if "fallback_device" in jx:
+        out["fallback_device"] = jx["fallback_device"]
     print(json.dumps(out))
 
 

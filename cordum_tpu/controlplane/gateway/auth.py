@@ -51,13 +51,17 @@ class BasicAuthProvider(AuthProvider):
         auth = headers.get("Authorization", "")
         if not key and auth.startswith("Bearer "):
             key = auth[len("Bearer "):]
-        if self.api_keys and key not in self.api_keys and key not in self.admin_keys:
+        # dev open mode ONLY when no keys of either kind are configured:
+        # admin_keys alone must still gate (and must not make anonymous
+        # requests key_admin)
+        keyed = bool(self.api_keys or self.admin_keys)
+        if keyed and key not in self.api_keys and key not in self.admin_keys:
             return None
-        key_admin = (key in self.admin_keys) or not self.api_keys
+        key_admin = (key in self.admin_keys) or not keyed
         role = headers.get("X-Principal-Role", "")
         if key and key in self.admin_keys:
             role = role or "admin"
-        elif self.api_keys and role == "admin":
+        elif keyed and role == "admin":
             role = "user"  # header may not escalate a non-admin key
         allowed_tenant = self.key_tenants.get(key, self.default_tenant)
         requested = headers.get("X-Tenant-Id", "")
@@ -67,7 +71,7 @@ class BasicAuthProvider(AuthProvider):
             principal_id=headers.get("X-Principal-Id", "anonymous"),
             role=role or "user",
             tenant_id=requested or allowed_tenant,
-            authenticated=bool(key) or not self.api_keys,
+            authenticated=bool(key) or not keyed,
             key_admin=key_admin,
         )
 

@@ -129,6 +129,22 @@ class SafetyKernel:
                         }
             elif raw is not None:
                 doc = yaml.safe_load(raw) or {}
+        # Schema-validate the file-level policy before merging fragments: a
+        # malformed safety.yaml fails startup with a pointed error; on hot
+        # reload the previous good policy is kept (reference validation.go:11).
+        from ...infra.configschema import SAFETY_SCHEMA, ConfigError, validate
+
+        try:
+            validate(doc, SAFETY_SCHEMA, self._policy_path or "policy_doc")
+        except ConfigError as e:
+            if self._merged_doc:
+                import logging as _l
+
+                _l.getLogger("cordum").error(
+                    "invalid policy document on reload (%s); keeping previous", e
+                )
+                return self._snapshot_id
+            raise
         rules = list(doc.get("rules") or [])
         if self._configsvc is not None:
             for frag_id in sorted(await self._configsvc.list("system")):

@@ -265,6 +265,46 @@ class Engine:
             self.metrics.dispatch_latency.observe(max(0.0, time.time() - sub_us / 1e6))
 
     # ------------------------------------------------------------------
+    async def redispatch_scheduled(self, job_id: str) -> bool:
+        """Re-publish a job wedged in SCHEDULED (crash/bus blip between
+        set_state(SCHEDULED) and the dispatch publish).  Safety was already
+        checked on the original pass; this only repeats the dispatch leg —
+        with the attempts guard, so a persistently failing publish still
+        lands in the DLQ instead of looping forever.  Driven by the
+        PendingReplayer; returns True if the job moved."""
+        if not await self.job_store.acquire_job_lock(job_id, self.instance_id, ttl_s=30.0):
+            return False
+        try:
+            if await self.job_store.get_state(job_id) != JobState.SCHEDULED.value:
+                return False  # moved on concurrently
+            req = await self.job_store.get_request(job_id)
+            if req is None:
+                return False
+            meta = await self.job_store.get_meta(job_id)
+            attempts = int(meta.get("attempts", "0")) + 1
+            await self.job_store.set_fields(job_id, {"attempts": str(attempts)})
+            if attempts > self.max_attempts:
+                await self._fail_to_dlq(req, "max attempts exceeded", "MAX_RETRIES")
+                return True
+            target = self.strategy.pick_subject(req)
+            # fresh bus msg-id label: the redispatch must survive the dedupe
+            # window even if the original publish reached the bus
+            req.labels = dict(req.labels or {})
+            req.labels["cordum.bus_msg_id"] = f"redispatch-{job_id}-{attempts}"
+            out = BusPacket.wrap(req, trace_id=meta.get("trace_id", ""),
+                                 sender_id=self.instance_id)
+            await self.bus.publish(target, out)
+            await self.job_store.set_state(
+                job_id, JobState.DISPATCHED,
+                fields={"dispatch_subject": target}, event="redispatched",
+            )
+            await self.job_store.set_state(job_id, JobState.RUNNING, event="running")
+            self.metrics.jobs_dispatched.inc(topic=req.topic)
+            return True
+        finally:
+            await self.job_store.release_job_lock(job_id, self.instance_id)
+
+    # ------------------------------------------------------------------
     async def _check_safety(self, req: JobRequest):
         """Approval-granted fast path with hash binding, else kernel check."""
         from ...protocol.types import PolicyCheckResponse

@@ -178,4 +178,17 @@ class PendingReplayer:
                 n += 1
             except Exception:
                 logx.warn("replay failed", job_id=job_id)
+        # SCHEDULED-but-never-published (crash/bus blip between
+        # set_state(SCHEDULED) and the dispatch publish): the submit-path
+        # in-flight short-circuit deliberately ignores redeliveries for these,
+        # so the replayer re-drives the dispatch leg directly
+        wedged = await self.job_store.list_by_state_older_than(
+            JobState.SCHEDULED.value, cutoff_us, BATCH
+        )
+        for job_id in wedged:
+            try:
+                if await self.engine.redispatch_scheduled(job_id):
+                    n += 1
+            except Exception:
+                logx.warn("redispatch failed", job_id=job_id)
         return n

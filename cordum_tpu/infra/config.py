@@ -85,7 +85,10 @@ class PoolConfig:
         return [self.pools[n] for n in names if n in self.pools]
 
 
-def parse_pool_config(doc: dict) -> PoolConfig:
+def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
+    from .configschema import POOLS_SCHEMA, validate
+
+    validate(doc, POOLS_SCHEMA, source)
     cfg = PoolConfig()
     for name, p in (doc.get("pools") or {}).items():
         p = p or {}
@@ -109,7 +112,9 @@ def load_pool_config(path: str) -> PoolConfig:
         # default: one pool, default topic routed to it
         return parse_pool_config({"topics": {"job.default": "default"}, "pools": {"default": {}}})
     with open(path) as f:
-        return parse_pool_config(yaml.safe_load(f) or {})
+        # schema-validated at parse: a typo'd pool file fails startup with a
+        # pointed error instead of loading silently (reference validation.go:11)
+        return parse_pool_config(yaml.safe_load(f) or {}, source=path)
 
 
 # ---------------------------------------------------------------------------
@@ -126,7 +131,10 @@ class Timeouts:
     per_topic: dict[str, float] = field(default_factory=dict)
 
 
-def parse_timeouts(doc: dict) -> Timeouts:
+def parse_timeouts(doc: dict, *, source: str = "timeouts") -> Timeouts:
+    from .configschema import TIMEOUTS_SCHEMA, validate
+
+    validate(doc, TIMEOUTS_SCHEMA, source)
     t = Timeouts()
     rec = doc.get("reconciler") or {}
     t.dispatch_timeout_s = float(rec.get("dispatch_timeout_seconds", t.dispatch_timeout_s))
@@ -141,4 +149,4 @@ def load_timeouts(path: str) -> Timeouts:
     if not os.path.exists(path):
         return Timeouts()
     with open(path) as f:
-        return parse_timeouts(yaml.safe_load(f) or {})
+        return parse_timeouts(yaml.safe_load(f) or {}, source=path)

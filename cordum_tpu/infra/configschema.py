@@ -1,0 +1,315 @@
+"""Config validation: embedded JSON schemas + the typed config taxonomy.
+
+The reference validates every YAML config file at parse time against
+embedded JSON schemas (``core/infra/config/validation.go:11``,
+``config/schema/*.schema.json``) and defines a typed taxonomy of effective-
+config fields (``core/infra/config/categories.go:6-160``: safety / budget /
+rate / retry / resources / models / context / slo / observability /
+alerting).  This module is the TPU-native equivalent: a typo'd pool file or
+malformed safety policy fails startup with a pointed error instead of
+loading silently, and the taxonomy documents (and validates) every
+effective-config field the code actually reads.
+
+``python -m cordum_tpu.infra.configschema`` prints the taxonomy as markdown
+(the generated doc lives at ``docs/CONFIG.md``).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jsonschema
+
+
+class ConfigError(ValueError):
+    """A config document failed schema validation."""
+
+
+_STR_LIST = {"type": "array", "items": {"type": "string"}}
+_STR_MAP = {"type": "object", "additionalProperties": {"type": "string"}}
+_NONNEG = {"type": "number", "minimum": 0}
+_NONNEG_INT = {"type": "integer", "minimum": 0}
+
+# ---------------------------------------------------------------------------
+# pools.yaml  (reference core/infra/config/pools.go + pool.schema.json)
+# ---------------------------------------------------------------------------
+
+POOLS_SCHEMA: dict[str, Any] = {
+    "$schema": "https://json-schema.org/draft/2020-12/schema",
+    "type": "object",
+    "properties": {
+        "topics": {
+            "type": "object",
+            "additionalProperties": {
+                "anyOf": [{"type": "string"}, _STR_LIST],
+            },
+        },
+        "pools": {
+            "type": "object",
+            "additionalProperties": {
+                "anyOf": [{"type": "null"}, {
+                    "type": "object",
+                    "properties": {
+                        "requires": _STR_LIST,
+                        "max_parallel_jobs": _NONNEG_INT,
+                        # TPU slice constraints (north-star extension)
+                        "min_chips": _NONNEG_INT,
+                        "topology": {"type": "string", "pattern": r"^(\d+x\d+(x\d+)?)?$"},
+                        "device_kind": {"type": "string"},
+                    },
+                    "additionalProperties": False,
+                }],
+            },
+        },
+        # tolerated here so one file can carry pools + reconciler (dev mode)
+        "reconciler": {"type": "object"},
+    },
+    "additionalProperties": False,
+}
+
+# ---------------------------------------------------------------------------
+# timeouts.yaml  (reference core/infra/config/timeouts.go)
+# ---------------------------------------------------------------------------
+
+TIMEOUTS_SCHEMA: dict[str, Any] = {
+    "$schema": "https://json-schema.org/draft/2020-12/schema",
+    "type": "object",
+    "properties": {
+        "reconciler": {
+            "type": "object",
+            "properties": {
+                "dispatch_timeout_seconds": _NONNEG,
+                "running_timeout_seconds": _NONNEG,
+                "scan_interval_seconds": _NONNEG,
+            },
+            "additionalProperties": False,
+        },
+        "workflows": {"type": "object", "additionalProperties": _NONNEG},
+        "topics": {"type": "object", "additionalProperties": _NONNEG},
+    },
+    "additionalProperties": False,
+}
+
+# ---------------------------------------------------------------------------
+# safety.yaml  (reference core/infra/config/safety_policy.go:13-146 +
+# safety_policy.schema.json; TPU additions: max_chips/allowed_topologies)
+# ---------------------------------------------------------------------------
+
+_MCP_SCHEMA = {
+    "type": "object",
+    "properties": {
+        f"{d}_{kind}": _STR_LIST
+        for d in ("allow", "deny")
+        for kind in ("servers", "tools", "resources", "actions")
+    },
+    "additionalProperties": False,
+}
+
+_CONSTRAINTS_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "max_tokens": _NONNEG_INT,
+        "max_cost_usd": _NONNEG,
+        "sandbox": {"type": "string"},
+        "toolchain": {"type": "string"},
+        "diff_limit": {"type": "string"},
+        "redaction_level": {"type": "string"},
+        "max_chips": _NONNEG_INT,
+        "allowed_topologies": _STR_LIST,
+        "env": _STR_MAP,
+    },
+    "additionalProperties": False,
+}
+
+_RULE_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "id": {"type": "string"},
+        "description": {"type": "string"},
+        "match": {
+            "type": "object",
+            "properties": {
+                "tenants": _STR_LIST,
+                "topics": _STR_LIST,
+                "capabilities": _STR_LIST,
+                "risk_tags": _STR_LIST,
+                "requires": _STR_LIST,
+                "pack_ids": _STR_LIST,
+                "actor_ids": _STR_LIST,
+                "actor_types": _STR_LIST,
+                "labels": _STR_MAP,
+                "secrets_present": {"type": "boolean"},
+                "mcp": {"type": "boolean"},
+            },
+            "additionalProperties": False,
+        },
+        "decision": {
+            "enum": ["allow", "deny", "require_approval",
+                     "allow_with_constraints", "throttle"],
+        },
+        "reason": {"type": "string"},
+        "constraints": _CONSTRAINTS_SCHEMA,
+        "remediations": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {
+                    "id": {"type": "string"},
+                    "description": {"type": "string"},
+                    "replacement_topic": {"type": "string"},
+                    "replacement_capability": {"type": "string"},
+                    "add_labels": _STR_MAP,
+                    "remove_labels": _STR_LIST,
+                },
+                "additionalProperties": False,
+            },
+        },
+        "throttle_delay_s": _NONNEG,
+    },
+    "required": ["decision"],
+    "additionalProperties": False,
+}
+
+SAFETY_SCHEMA: dict[str, Any] = {
+    "$schema": "https://json-schema.org/draft/2020-12/schema",
+    "type": "object",
+    "properties": {
+        "default_tenant": {"type": "string"},
+        "tenants": {
+            "type": "object",
+            "additionalProperties": {
+                "type": "object",
+                "properties": {
+                    "allow_topics": _STR_LIST,
+                    "deny_topics": _STR_LIST,
+                    "max_concurrent_jobs": _NONNEG_INT,
+                    "mcp": _MCP_SCHEMA,
+                },
+                "additionalProperties": False,
+            },
+        },
+        "rules": {"type": "array", "items": _RULE_SCHEMA},
+    },
+    "additionalProperties": False,
+}
+
+# ---------------------------------------------------------------------------
+# Effective-config taxonomy (reference categories.go:6-160).  One entry per
+# field the control plane actually reads from the merged effective config.
+# Each: (category, field, type, consumer, description).
+# ---------------------------------------------------------------------------
+
+TAXONOMY: list[tuple[str, str, str, str, str]] = [
+    ("safety", "safety.denied_topics", "list[str]",
+     "safetykernel.kernel", "extra topic globs denied for every tenant"),
+    ("safety", "safety.default_decision", "str",
+     "safetykernel.kernel", "fallback decision when no rule matches (allow|deny)"),
+    ("safety", "safety.require_approval_topics", "list[str]",
+     "safetykernel.kernel", "topic globs that always require human approval"),
+    ("budget", "budgets.max_tokens", "int",
+     "scheduler.engine", "per-job token ceiling clamped into JobRequest.budget"),
+    ("budget", "budgets.max_cost_usd", "float",
+     "scheduler.engine", "per-job cost ceiling"),
+    ("budget", "budgets.deadline_seconds", "int",
+     "scheduler.engine", "default job deadline when the request carries none"),
+    ("rate", "rate_limits.concurrent_jobs", "int",
+     "scheduler.engine", "per-tenant concurrent-job cap (org-scoped overrides win)"),
+    ("rate", "rate_limits.api_rps", "float",
+     "gateway.app", "gateway token-bucket refill rate"),
+    ("rate", "rate_limits.api_burst", "int",
+     "gateway.app", "gateway token-bucket burst size"),
+    ("retry", "retry.max_attempts", "int",
+     "scheduler.engine", "dispatch attempts before DLQ"),
+    ("retry", "retry.backoff_base_seconds", "float",
+     "workflow.engine", "workflow step retry backoff base"),
+    ("retry", "retry.backoff_multiplier", "float",
+     "workflow.engine", "workflow step retry backoff multiplier"),
+    ("resources", "resources.default_pool", "str",
+     "scheduler.strategy", "pool used when no topic route matches"),
+    ("resources", "resources.max_chips", "int",
+     "scheduler.strategy", "slice-size ceiling applied to placements"),
+    ("resources", "resources.allowed_topologies", "list[str]",
+     "scheduler.strategy", "ICI topologies a tenant may occupy (e.g. 2x2x1)"),
+    ("models", "models.default_model", "str",
+     "worker.handlers", "model id used by model-exec jobs with no explicit model"),
+    ("models", "models.allowed_models", "list[str]",
+     "safetykernel.kernel", "allowlist for model-exec topics"),
+    ("models", "models.dtype", "str",
+     "worker.training", "compute dtype for TPU jobs (bfloat16|float32)"),
+    ("context", "context.window_tokens", "int",
+     "context.service", "BuildWindow token budget default"),
+    ("context", "context.history_events", "int",
+     "context.service", "CHAT/RAG mode: trailing history events attached"),
+    ("context", "context.rag_top_k", "int",
+     "context.service", "RAG mode: chunks retrieved per query"),
+    ("context", "context.embed_batch", "int",
+     "context.service", "TPU embedder batch size (pad-to-batch on MXU)"),
+    ("slo", "slo.dispatch_p99_ms", "float",
+     "infra.metrics", "alert threshold: dispatch latency p99"),
+    ("slo", "slo.e2e_p99_ms", "float",
+     "infra.metrics", "alert threshold: submit→result p99"),
+    ("observability", "observability.log_format", "str",
+     "infra.logging", "text|json"),
+    ("observability", "observability.trace_sample_rate", "float",
+     "infra.jobstore", "fraction of jobs recorded into trace sets"),
+    ("alerting", "alerting.dlq_depth_warn", "int",
+     "infra.dlq", "DLQ depth that trips a SystemAlert"),
+    ("alerting", "alerting.worker_loss_warn", "int",
+     "infra.registry", "expired-worker count that trips a SystemAlert"),
+]
+
+_TYPE_TO_SCHEMA = {
+    "int": _NONNEG_INT,
+    "float": _NONNEG,
+    "str": {"type": "string"},
+    "list[str]": _STR_LIST,
+}
+
+
+def effective_schema() -> dict[str, Any]:
+    """JSON schema for the merged effective config, generated from TAXONOMY.
+
+    Unknown top-level categories are allowed (packs may overlay their own
+    namespaces); known categories reject unknown/mistyped fields.
+    """
+    cats: dict[str, dict] = {}
+    for _, path, typ, _, _ in TAXONOMY:
+        cat, key = path.split(".", 1)
+        c = cats.setdefault(cat, {"type": "object", "properties": {},
+                                  "additionalProperties": False})
+        c["properties"][key] = _TYPE_TO_SCHEMA[typ]
+    return {"type": "object", "properties": cats}
+
+
+def validate(doc: Any, schema: dict[str, Any], source: str = "config") -> None:
+    """Raise :class:`ConfigError` with a pointed path on schema violation."""
+    v = jsonschema.Draft202012Validator(schema)
+    errors = sorted(v.iter_errors(doc), key=lambda e: list(e.absolute_path))
+    if errors:
+        e = errors[0]
+        where = "/".join(str(p) for p in e.absolute_path) or "<root>"
+        more = f" (+{len(errors) - 1} more)" if len(errors) > 1 else ""
+        raise ConfigError(f"{source}: {where}: {e.message}{more}")
+
+
+def taxonomy_markdown() -> str:
+    """The taxonomy rendered as the docs/CONFIG.md table."""
+    out = [
+        "# Effective-config taxonomy",
+        "",
+        "Generated by `python -m cordum_tpu.infra.configschema` from",
+        "`cordum_tpu/infra/configschema.py` (reference analogue:",
+        "`core/infra/config/categories.go:6-160`). Fields merge shallowly",
+        "system → org → team → workflow → step (`infra/configsvc.py`) and",
+        "reach jobs as the `CORDUM_EFFECTIVE_CONFIG` env var.",
+        "",
+        "| Category | Field | Type | Consumer | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for cat, path, typ, consumer, desc in TAXONOMY:
+        out.append(f"| {cat} | `{path}` | `{typ}` | `{consumer}` | {desc} |")
+    out.append("")
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    print(taxonomy_markdown())

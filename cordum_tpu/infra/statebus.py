@@ -104,10 +104,14 @@ class StateBusServer:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # Close client writers BEFORE wait_closed: Python 3.12's
+        # Server.wait_closed() waits for connection handlers to finish, and
+        # handlers block reading from clients that never hang up.
         for w in list(self._writers):
             w.close()
+        if self._server:
+            await self._server.wait_closed()
+            self._server = None
         if self._aof:
             self._aof.flush()
             self._aof.close()
@@ -233,26 +237,60 @@ class StateBusServer:
 
 
 class StateBusConn:
-    """Shared TCP connection: request/response + push routing."""
+    """Shared TCP connection: request/response + push routing.
 
-    def __init__(self, host: str, port: int):
+    Auto-reconnects with exponential backoff when the connection drops
+    (reference NATS behavior: infinite reconnect, ``nats.go:59``).  In-flight
+    calls fail with :class:`ConnectionError`; subsequent calls wait for the
+    reconnect (bounded by their timeout) and succeed; subscriptions are
+    re-issued server-side on every reconnect, so one statebus blip no longer
+    wedges a service until restart.
+    """
+
+    def __init__(self, host: str, port: int, *, reconnect: bool = True,
+                 max_backoff_s: float = 2.0):
         self.host = host
         self.port = port
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._req_id = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
-        self._handlers: dict[int, Any] = {}  # sid → async handler(subject, bytes)
+        self._handlers: dict[int, Any] = {}  # server sid → async handler(subject, bytes)
         self._reader_task: Optional[asyncio.Task] = None
         self._lock = asyncio.Lock()
         self._closed = False
+        self._reconnect = reconnect
+        self._max_backoff_s = max_backoff_s
+        self._connected = asyncio.Event()
+        self._reconnect_task: Optional[asyncio.Task] = None
+        # client-side subscription registry (survives reconnects):
+        # local id → {pattern, queue, handler, sid}
+        self._local_sid = itertools.count(1)
+        self._subs: dict[int, dict] = {}
+        self.reconnect_count = 0
+        # connection epoch: bumped on every successful dial; server sids are
+        # only meaningful within the epoch that created them (a restarted
+        # server reuses low sids, so a stale unsub could kill the wrong sub)
+        self._epoch = 0
 
     async def connect(self) -> None:
+        await self._dial()
+
+    async def _dial(self) -> None:
+        if self._reader_task is not None and not self._reader_task.done():
+            # a reader for a dead/obsolete connection must not linger (its
+            # tail would spawn a second reconnect loop → duplicate dials)
+            self._reader_task.cancel()
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._epoch += 1
         self._reader_task = asyncio.ensure_future(self._read_loop())
+        self._connected.set()
 
     async def close(self) -> None:
         self._closed = True
+        self._connected.set()  # release any call() waiting on reconnect
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
         if self._reader_task:
             self._reader_task.cancel()
         if self._writer:
@@ -281,20 +319,106 @@ class StateBusConn:
                     fut.set_result(result)
                 else:
                     fut.set_exception(RuntimeError(f"statebus: {result}"))
+        # connection lost: fail in-flight calls, then (unless deliberately
+        # closed) start the reconnect loop
+        self._connected.clear()
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionError("statebus connection lost"))
         self._pending.clear()
+        if not self._closed and self._reconnect:
+            t = self._reconnect_task
+            if t is None or t.done():  # never two concurrent reconnect loops
+                logx.warn("statebus connection lost; reconnecting",
+                          host=self.host, port=self.port)
+                self._reconnect_task = asyncio.ensure_future(self._reconnect_loop())
 
+    async def _reconnect_loop(self) -> None:
+        backoff = 0.05
+        while not self._closed:
+            try:
+                await self._dial()
+                await self._resubscribe()
+                self.reconnect_count += 1
+                logx.info("statebus reconnected", host=self.host, port=self.port,
+                          subs=len(self._subs))
+                return
+            except (OSError, ConnectionError):
+                # dial refused OR the fresh connection died mid-resubscribe —
+                # either way this same loop retries (the dead reader task is
+                # cancelled by the next _dial, so no second loop spawns)
+                self._connected.clear()
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, self._max_backoff_s)
+
+    async def _resubscribe(self) -> None:
+        """Re-issue every registered subscription on the fresh connection."""
+        self._handlers.clear()
+        for entry in self._subs.values():
+            sid = await self._call_now("sub", entry["pattern"], entry["queue"] or "")
+            entry["sid"] = sid
+            entry["epoch"] = self._epoch
+            self._handlers[sid] = entry["handler"]
+
+    # -- subscriptions (registry survives reconnects) -------------------
+    async def subscribe(self, pattern: str, queue: str, handler) -> int:
+        local = next(self._local_sid)
+        # register in _subs only AFTER the server ack: a subscribe that rides
+        # a reconnect must not ALSO be issued by _resubscribe (double sid →
+        # every message delivered twice)
+        sid = await self.call("sub", pattern, queue or "")
+        self._subs[local] = {"pattern": pattern, "queue": queue,
+                             "handler": handler, "sid": sid, "epoch": self._epoch}
+        self._handlers[sid] = handler
+        return local
+
+    async def unsubscribe(self, local: int) -> None:
+        entry = self._subs.pop(local, None)
+        if entry is None:
+            return
+        sid = entry.get("sid")
+        if sid is not None:
+            self._handlers.pop(sid, None)
+            if entry.get("epoch") != self._epoch or not self._connected.is_set():
+                # sid belongs to a dead connection (a restarted server reuses
+                # sids, so sending it could kill a live sub), or we're
+                # disconnected (server already dropped the sub; the entry is
+                # out of _subs so _resubscribe won't revive it)
+                return
+            try:
+                # _call_now (not call): must never ride a reconnect, where the
+                # epoch would have moved on under us
+                await self._call_now("unsub", sid, timeout_s=2.0)
+            except (ConnectionError, RuntimeError):
+                pass  # server side cleans up on disconnect anyway
+
+    # -- calls ----------------------------------------------------------
     async def call(self, op: str, *args: Any, timeout_s: float = 15.0) -> Any:
         if self._closed:
             raise ConnectionError("statebus connection closed")
+        if not self._connected.is_set():
+            # disconnected: wait (bounded) for the reconnect loop to win
+            try:
+                await asyncio.wait_for(self._connected.wait(), timeout_s)
+            except asyncio.TimeoutError:
+                raise ConnectionError(
+                    f"statebus call {op!r}: not connected after {timeout_s}s"
+                )
+            if self._closed:
+                raise ConnectionError("statebus connection closed")
+        return await self._call_now(op, *args, timeout_s=timeout_s)
+
+    async def _call_now(self, op: str, *args: Any, timeout_s: float = 15.0) -> Any:
         req_id = next(self._req_id)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        async with self._lock:
-            self._writer.write(_encode([req_id, op, *args]))
-            await self._writer.drain()
+        try:
+            async with self._lock:
+                self._writer.write(_encode([req_id, op, *args]))
+                await self._writer.drain()
+        except (ConnectionError, OSError) as e:
+            self._pending.pop(req_id, None)
+            raise ConnectionError(f"statebus call {op!r} failed: {e}")
         try:
             # bounded wait: a half-open TCP connection (host died without
             # FIN/RST) must surface as an error, not wedge the service
@@ -381,12 +505,10 @@ class StateBusBus(Bus):
             except Exception:
                 logx.error("bus handler error", subject=subject)
 
-        sid = await self.conn.call("sub", pattern, queue or "")
-        self.conn._handlers[sid] = deliver
+        local = await self.conn.subscribe(pattern, queue or "", deliver)
 
         def _unsub() -> None:
-            self.conn._handlers.pop(sid, None)
-            asyncio.ensure_future(self.conn.call("unsub", sid))
+            asyncio.ensure_future(self.conn.unsubscribe(local))
 
         return Subscription(_unsub)
 

@@ -65,7 +65,11 @@ class JobContext:
         await self.worker.publish_progress(self.request.job_id, percent, message)
 
 
-Handler = Callable[[JobContext], Awaitable[Any]]
+# Handlers may be ``async def`` (must not block the loop — use
+# ``ctx.worker.run_in_executor`` for blocking JAX work) or plain ``def``
+# (automatically dispatched to the worker's thread pool so a blocking
+# computation can never stall heartbeats/cancel delivery).
+Handler = Callable[[JobContext], Any]
 
 
 class Worker:
@@ -187,7 +191,16 @@ class Worker:
             handler = self._handlers.get(req.topic) or self._handlers.get(req.adapter_id) or self._default_handler
             if handler is None:
                 raise RuntimeError(f"no handler for topic {req.topic!r}")
-            out = await handler(ctx)
+            import inspect
+
+            if inspect.iscoroutinefunction(handler):
+                out = await handler(ctx)
+            else:
+                # sync handler: enforce executor dispatch so blocking JAX
+                # work cannot stall the loop (heartbeats keep flowing)
+                out = await self.run_in_executor(handler, ctx)
+                if inspect.isawaitable(out):  # sync fn returned a coroutine
+                    out = await out
             if out is not None:
                 result_ptr = await self.store.put_result(req.job_id, out)
         except JobCancelled:

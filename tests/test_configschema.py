@@ -1,0 +1,81 @@
+"""Config schema validation: malformed pools/timeouts/safety files fail at
+parse with pointed errors; shipped config files validate; taxonomy doc
+stays generated (reference ``core/infra/config/validation.go:11`` +
+``categories.go:6-160``)."""
+import os
+
+import pytest
+import yaml
+
+from cordum_tpu.infra.config import (
+    load_pool_config, load_timeouts, parse_pool_config, parse_timeouts,
+)
+from cordum_tpu.infra.configschema import (
+    ConfigError, SAFETY_SCHEMA, effective_schema, taxonomy_markdown, validate,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_pool_typo_fails_with_pointed_error(tmp_path):
+    p = tmp_path / "pools.yaml"
+    p.write_text("pools:\n  tpu:\n    min_chip: 4\n")  # typo: min_chip
+    with pytest.raises(ConfigError, match="min_chip"):
+        load_pool_config(str(p))
+    p.write_text("pools:\n  tpu:\n    topology: not-a-topology\n")
+    with pytest.raises(ConfigError, match="topology"):
+        load_pool_config(str(p))
+    p.write_text("pools:\n  tpu:\n    min_chips: -1\n")
+    with pytest.raises(ConfigError):
+        load_pool_config(str(p))
+
+
+def test_timeouts_typo_fails(tmp_path):
+    p = tmp_path / "timeouts.yaml"
+    p.write_text("reconciler:\n  dispatch_timeout_secs: 10\n")  # typo
+    with pytest.raises(ConfigError, match="dispatch_timeout_secs"):
+        load_timeouts(str(p))
+    p.write_text("reconciler:\n  scan_interval_seconds: fast\n")
+    with pytest.raises(ConfigError, match="scan_interval_seconds"):
+        load_timeouts(str(p))
+
+
+def test_safety_policy_validation():
+    validate(yaml.safe_load(open(f"{REPO}/config/safety.yaml")), SAFETY_SCHEMA)
+    with pytest.raises(ConfigError, match="decision"):
+        validate({"rules": [{"decision": "alow"}]}, SAFETY_SCHEMA)  # typo enum
+    with pytest.raises(ConfigError, match="topic"):
+        validate({"rules": [{"decision": "deny", "match": {"topic": ["x"]}}]},
+                 SAFETY_SCHEMA)  # topic vs topics
+
+
+async def test_kernel_rejects_malformed_policy_at_startup(tmp_path):
+    from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+
+    p = tmp_path / "safety.yaml"
+    p.write_text("rules:\n  - decision: alow\n")
+    with pytest.raises(ConfigError):
+        await SafetyKernel(policy_path=str(p)).reload()
+    # hot reload keeps the previous good policy instead of raising
+    p.write_text("rules:\n  - {id: r, decision: deny, match: {topics: ['x.*']}}\n")
+    k = SafetyKernel(policy_path=str(p))
+    snap = await k.reload()
+    p.write_text("rules:\n  - decision: alow\n")
+    assert await k.reload() == snap
+
+
+def test_shipped_configs_validate():
+    assert load_pool_config(f"{REPO}/config/pools.yaml").pools["tpu"].requires == ["tpu"]
+    assert load_timeouts(f"{REPO}/config/timeouts.yaml").dispatch_timeout_s == 300
+
+
+def test_effective_schema_and_taxonomy_doc():
+    es = effective_schema()
+    validate({"rate_limits": {"concurrent_jobs": 8}, "custom_pack_ns": {"x": 1}}, es)
+    with pytest.raises(ConfigError, match="concurrent_jobs"):
+        validate({"rate_limits": {"concurrent_jobs": "many"}}, es)
+    with pytest.raises(ConfigError):
+        validate({"rate_limits": {"concurent_jobs": 8}}, es)  # typo field
+    # the committed doc is the generated doc (keeps docs/CONFIG.md honest)
+    with open(f"{REPO}/docs/CONFIG.md") as f:
+        assert f.read() == taxonomy_markdown()

@@ -324,6 +324,38 @@ async def test_pending_replayer_redrives():
     assert await js.get_state("j1") == "RUNNING"
 
 
+async def test_replayer_redispatches_wedged_scheduled():
+    """A job persisted as SCHEDULED whose dispatch publish never happened
+    (crash/bus blip) is re-driven by the replayer — the submit-path in-flight
+    short-circuit intentionally ignores redeliveries for it (review finding)."""
+    eng, bus, js, kv, reg = make_engine()
+    reg.update(hb("w1"))
+    req = JobRequest(job_id="j1", topic="job.default")
+    await js.put_request(req)
+    await js.set_state("j1", JobState.PENDING)
+    await js.set_state("j1", JobState.SCHEDULED, fields={"dispatch_subject": "worker.w1.jobs"})
+    await asyncio.sleep(0.01)
+    # redelivered submit is a no-op (in-flight short-circuit)
+    await eng.handle_job_request(req)
+    assert await js.get_state("j1") == "SCHEDULED"
+    assert not [p for s, p in bus.published if s == "worker.w1.jobs"]
+    # the replayer recovers it through the dispatch leg
+    rep = PendingReplayer(eng, js, Timeouts(dispatch_timeout_s=0.0))
+    n = await rep.run_once()
+    assert n == 1
+    assert await js.get_state("j1") == "RUNNING"
+    sent = [p for s, p in bus.published if s == "worker.w1.jobs"]
+    assert sent and sent[0].job_request.job_id == "j1"
+    # exhausting attempts lands in the DLQ instead of looping forever
+    await js.put_request(JobRequest(job_id="j2", topic="job.default"))
+    await js.set_state("j2", JobState.PENDING)
+    await js.set_state("j2", JobState.SCHEDULED)
+    await js.set_fields("j2", {"attempts": str(eng.max_attempts)})
+    await rep.run_once()
+    assert await js.get_state("j2") in ("FAILED", "DLQ", "DENIED") or \
+        (await js.get_meta("j2")).get("error_code") == "MAX_RETRIES"
+
+
 def test_naive_strategy():
     assert NaiveStrategy().pick_subject(JobRequest(job_id="j", topic="job.x")) == "job.x"
 

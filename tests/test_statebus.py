@@ -114,6 +114,74 @@ async def test_aof_persistence(tmp_path):
         await srv2.stop()
 
 
+async def test_client_reconnects_and_resubscribes(tmp_path):
+    """Kill the statebus mid-flow: in-flight calls fail, but the client
+    reconnects with backoff, re-issues its subscriptions, and the stack
+    recovers without a process restart (reference NATS: infinite reconnect,
+    nats.go:59)."""
+    aof = str(tmp_path / "state.aof")
+    srv = await start_server(aof_path=aof)
+    port = srv.port
+    kv, bus, conn = await connect(f"statebus://127.0.0.1:{port}")
+    got = []
+
+    async def h(s, p):
+        got.append(p.job_request.job_id)
+
+    try:
+        await bus.subscribe("sys.job.submit", h, queue="g")
+        await kv.set("before", b"1")
+        await bus.publish(subj.SUBMIT, BusPacket.wrap(JobRequest(job_id="j0", topic="t")))
+        await asyncio.sleep(0.1)
+        assert got == ["j0"]
+
+        # hard-kill the server
+        await srv.stop()
+        await asyncio.sleep(0.05)
+        with pytest.raises(ConnectionError):
+            await conn.call("set", "during", b"x", timeout_s=0.3)  # fails while down (bounded)
+        # restart on the same port with the same AOF
+        srv2 = StateBusServer(port=port, aof_path=aof)
+        await srv2.start()
+        # next calls ride the reconnect (call() waits for _connected)
+        assert await kv.get("before") == b"1"
+        assert conn.reconnect_count == 1
+        # subscription survived the blip — no re-subscribe by the app
+        await bus.publish(subj.SUBMIT, BusPacket.wrap(JobRequest(job_id="j1", topic="t")))
+        await asyncio.sleep(0.15)
+        assert got == ["j0", "j1"]
+        await srv2.stop()
+    finally:
+        await conn.close()
+
+
+async def test_reconnect_waits_with_backoff(tmp_path):
+    """A call issued while the server is still down blocks until the server
+    returns (within its timeout) instead of erroring permanently."""
+    aof = str(tmp_path / "state.aof")
+    srv = await start_server(aof_path=aof)
+    port = srv.port
+    kv, bus, conn = await connect(f"statebus://127.0.0.1:{port}")
+    try:
+        await kv.set("k", b"v")
+        await srv.stop()
+        await asyncio.sleep(0.05)
+
+        async def bring_back():
+            await asyncio.sleep(0.4)
+            s2 = StateBusServer(port=port, aof_path=aof)
+            await s2.start()
+            return s2
+
+        task = asyncio.ensure_future(bring_back())
+        # issued while down; succeeds once the reconnect loop wins
+        assert await kv.get("k") == b"v"
+        srv2 = await task
+        await srv2.stop()
+    finally:
+        await conn.close()
+
+
 async def test_control_plane_over_statebus():
     """Scheduler + worker in 'separate processes' (separate connections)
     driving a job end-to-end through the TCP statebus."""

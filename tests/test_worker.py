@@ -70,6 +70,46 @@ async def test_worker_echo_roundtrip():
     await eng.stop()
 
 
+async def test_blocking_sync_handler_keeps_heartbeats_flowing():
+    """A plain-def handler doing blocking work is dispatched to the executor
+    by the runtime, so heartbeats keep flowing while it runs (VERDICT weak #5:
+    previously a blocking handler silently stopped heartbeats)."""
+    import time as _time
+
+    kv, bus, js, ms, eng = make_stack()
+    await eng.start()
+    w = Worker(bus=bus, store=ms, worker_id="w1", pool="default",
+               topics=["job.default"], heartbeat_interval_s=0.05)
+    beats = []
+
+    async def hb_tap(subject, pkt):
+        if pkt.heartbeat and pkt.heartbeat.worker_id == "w1":
+            beats.append((asyncio.get_running_loop().time(), pkt.heartbeat.active_jobs))
+
+    await bus.subscribe(subj.HEARTBEAT, hb_tap)
+
+    def blocking(ctx: JobContext):  # plain def: blocks its thread, not the loop
+        _time.sleep(0.6)
+        return {"ok": True}
+
+    w.register("job.default", blocking)
+    await w.start()
+    await settle(bus)
+    n0 = len(beats)
+    await bus.publish(subj.SUBMIT, BusPacket.wrap(JobRequest(job_id="jb", topic="job.default")))
+    # while the job blocks its executor thread, the loop must keep beating
+    for _ in range(12):
+        await bus.drain()
+        await asyncio.sleep(0.06)
+    assert await js.get_state("jb") == "SUCCEEDED"
+    assert await ms.get_result("jb") == {"ok": True}
+    during = len(beats) - n0
+    assert during >= 5, f"heartbeats stalled during blocking handler ({during})"
+    assert any(active > 0 for _, active in beats), "no heartbeat saw the active job"
+    await w.stop()
+    await eng.stop()
+
+
 async def test_worker_failure_reported():
     kv, bus, js, ms, eng = make_stack()
     await eng.start()
