@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 from typing import Any, Optional
 
@@ -192,17 +193,48 @@ class Gateway:
         r.add_get(f"{v1}/stream", self.ws_stream)
         r.add_get("/healthz", self.healthz)
         r.add_get("/metrics", self.get_metrics)
+        # operations dashboard (reference dashboard/ React app → served-static
+        # SPA here; same /api/v1 + WS surface underneath)
+        dash = os.path.join(os.path.dirname(os.path.abspath(__file__)), "dashboard")
+        if os.path.isdir(dash):
+            r.add_get("/", self._dash_index)
+            r.add_get("/ui", self._dash_index)
+            r.add_get("/ui/", self._dash_index)
+            r.add_static("/ui/", dash)
         return app
+
+    async def _dash_index(self, request: web.Request) -> web.Response:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "dashboard", "index.html")
+        return web.FileResponse(path)
 
     @web.middleware
     async def _middleware(self, request: web.Request, handler):
         t0 = time.perf_counter()
         if not self.rate.allow(request.headers.get("X-Api-Key", request.remote or "")):
             return _err(429, "rate limited")
-        if request.path in ("/healthz", "/metrics"):
+        if request.path in ("/healthz", "/metrics", "/") or request.path.startswith("/ui"):
             request["principal"] = Principal()
             return await handler(request)
-        principal = self.auth.authenticate(request.headers)
+        headers = request.headers
+        if (
+            request.path.endswith("/stream")
+            and "X-Api-Key" not in headers
+            and "Authorization" not in headers  # Bearer clients keep working
+        ):
+            # browsers can't set arbitrary WS headers; accept the API key as
+            # the first Sec-WebSocket-Protocol token (reference gateway.go:2002)
+            proto = headers.get("Sec-WebSocket-Protocol", "")
+            key = proto.split(",")[0].strip()
+            if key:
+                from multidict import CIMultiDict
+
+                # CIMultiDict copy: case-insensitive lookups (x-tenant-id
+                # etc.) must keep working on the overlaid header map
+                overlaid = CIMultiDict(headers)
+                overlaid["X-Api-Key"] = key
+                headers = overlaid
+        principal = self.auth.authenticate(headers)
         if principal is None:
             return _err(401, "invalid API key")
         request["principal"] = principal
@@ -1093,7 +1125,11 @@ class Gateway:
         origin = request.headers.get("Origin", "")
         if self.ws_allowed_origins is not None and origin and origin not in self.ws_allowed_origins:
             raise web.HTTPForbidden(reason="origin not allowed")
-        ws = web.WebSocketResponse(heartbeat=30)
+        # echo the offered subprotocol back so browser handshakes complete
+        # when the API key rides Sec-WebSocket-Protocol
+        offered = [p.strip() for p in
+                   request.headers.get("Sec-WebSocket-Protocol", "").split(",") if p.strip()]
+        ws = web.WebSocketResponse(heartbeat=30, protocols=offered or ())
         await ws.prepare(request)
         self._ws_clients.add(ws)
         try:

@@ -153,8 +153,23 @@ class SafetyKernel:
                 frag = await self._configsvc.get("system", frag_id)
                 if not frag or not frag.data.get("enabled", True):
                     continue
-                rules.extend(frag.data.get("rules") or [])
-                for tname, tpol in (frag.data.get("tenants") or {}).items():
+                # fragments get the same schema treatment as the file: a
+                # typo'd rule must not load silently — skip + log the
+                # offending fragment, keep the rest (hot-path equivalent of
+                # keep-previous-on-reload)
+                frag_doc = {"rules": frag.data.get("rules") or [],
+                            "tenants": frag.data.get("tenants") or {}}
+                try:
+                    validate(frag_doc, SAFETY_SCHEMA, f"policy fragment {frag_id}")
+                except ConfigError as e:
+                    import logging as _l
+
+                    _l.getLogger("cordum").error(
+                        "skipping invalid policy fragment: %s", e
+                    )
+                    continue
+                rules.extend(frag_doc["rules"])
+                for tname, tpol in frag_doc["tenants"].items():
                     doc.setdefault("tenants", {})[tname] = tpol
         doc["rules"] = rules
         h = _policy_hash(doc)

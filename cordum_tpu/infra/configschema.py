@@ -177,14 +177,18 @@ SAFETY_SCHEMA: dict[str, Any] = {
         "tenants": {
             "type": "object",
             "additionalProperties": {
-                "type": "object",
-                "properties": {
-                    "allow_topics": _STR_LIST,
-                    "deny_topics": _STR_LIST,
-                    "max_concurrent_jobs": _NONNEG_INT,
-                    "mcp": _MCP_SCHEMA,
-                },
-                "additionalProperties": False,
+                # null bodies tolerated (an empty `staging:` stanza is valid
+                # YAML and the parser treats it as {}), matching POOLS_SCHEMA
+                "anyOf": [{"type": "null"}, {
+                    "type": "object",
+                    "properties": {
+                        "allow_topics": _STR_LIST,
+                        "deny_topics": _STR_LIST,
+                        "max_concurrent_jobs": _NONNEG_INT,
+                        "mcp": _MCP_SCHEMA,
+                    },
+                    "additionalProperties": False,
+                }],
             },
         },
         "rules": {"type": "array", "items": _RULE_SCHEMA},

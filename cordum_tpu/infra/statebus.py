@@ -302,23 +302,31 @@ class StateBusConn:
         self._pending.clear()
 
     async def _read_loop(self) -> None:
-        while True:
-            frame = await _read_frame(self._reader)
-            if frame is None:
-                break
-            if frame[0] == 0 and frame[1] == "msg":
-                _, _, sid, subject, packet_bytes = frame
-                handler = self._handlers.get(sid)
-                if handler is not None:
-                    asyncio.ensure_future(handler(subject, packet_bytes))
-                continue
-            req_id, status, result = frame
-            fut = self._pending.pop(req_id, None)
-            if fut is not None and not fut.done():
-                if status == "ok":
-                    fut.set_result(result)
-                else:
-                    fut.set_exception(RuntimeError(f"statebus: {result}"))
+        try:
+            while True:
+                frame = await _read_frame(self._reader)
+                if frame is None:
+                    break
+                if frame[0] == 0 and frame[1] == "msg":
+                    _, _, sid, subject, packet_bytes = frame
+                    handler = self._handlers.get(sid)
+                    if handler is not None:
+                        asyncio.ensure_future(handler(subject, packet_bytes))
+                    continue
+                req_id, status, result = frame
+                fut = self._pending.pop(req_id, None)
+                if fut is not None and not fut.done():
+                    if status == "ok":
+                        fut.set_result(result)
+                    else:
+                        fut.set_exception(RuntimeError(f"statebus: {result}"))
+        except asyncio.CancelledError:
+            raise  # deliberate teardown (close/_dial); no recovery tail
+        except Exception:
+            # ANY reader failure (OSError ETIMEDOUT, corrupt frame, decode
+            # error) must fall into the recovery tail below — otherwise the
+            # client wedges with _connected still set and no reconnect
+            logx.warn("statebus read loop failed; treating as connection loss")
         # connection lost: fail in-flight calls, then (unless deliberately
         # closed) start the reconnect loop
         self._connected.clear()
@@ -354,7 +362,10 @@ class StateBusConn:
     async def _resubscribe(self) -> None:
         """Re-issue every registered subscription on the fresh connection."""
         self._handlers.clear()
-        for entry in self._subs.values():
+        # snapshot: _connected is already set, so a concurrent subscribe()
+        # may insert into _subs while we await — iterating the live dict
+        # would raise and kill the reconnect task
+        for entry in list(self._subs.values()):
             sid = await self._call_now("sub", entry["pattern"], entry["queue"] or "")
             entry["sid"] = sid
             entry["epoch"] = self._epoch
@@ -396,8 +407,12 @@ class StateBusConn:
     async def call(self, op: str, *args: Any, timeout_s: float = 15.0) -> Any:
         if self._closed:
             raise ConnectionError("statebus connection closed")
+        remaining = timeout_s
         if not self._connected.is_set():
-            # disconnected: wait (bounded) for the reconnect loop to win
+            # disconnected: wait (bounded) for the reconnect loop to win;
+            # the wait spends the caller's budget — total latency stays
+            # bounded by timeout_s, not 2x
+            t0 = time.monotonic()
             try:
                 await asyncio.wait_for(self._connected.wait(), timeout_s)
             except asyncio.TimeoutError:
@@ -406,7 +421,8 @@ class StateBusConn:
                 )
             if self._closed:
                 raise ConnectionError("statebus connection closed")
-        return await self._call_now(op, *args, timeout_s=timeout_s)
+            remaining = max(0.05, timeout_s - (time.monotonic() - t0))
+        return await self._call_now(op, *args, timeout_s=remaining)
 
     async def _call_now(self, op: str, *args: Any, timeout_s: float = 15.0) -> Any:
         req_id = next(self._req_id)

@@ -413,6 +413,43 @@ async def test_ws_stream_broadcast():
         await ws.close()
 
 
+async def test_ws_stream_key_via_subprotocol():
+    """Browsers can't set WS headers: the API key rides the first
+    Sec-WebSocket-Protocol token (reference gateway.go:2002) and the server
+    echoes the offered protocol so the handshake completes."""
+    async with GwStack() as s:
+        ws = await s.client.ws_connect("/api/v1/stream", protocols=("user-key",))
+        assert ws._response.headers.get("Sec-WebSocket-Protocol") == "user-key"
+        await s.client.post("/api/v1/jobs", json={"topic": "job.work", "payload": {}},
+                            headers=s.h())
+        msg = await asyncio.wait_for(ws.receive_json(), 5)
+        assert msg["subject"].startswith("sys.job.")
+        await ws.close()
+        # a bad key in the subprotocol is rejected
+        from aiohttp import WSServerHandshakeError
+        import pytest as _pytest
+        with _pytest.raises(WSServerHandshakeError):
+            await s.client.ws_connect("/api/v1/stream", protocols=("wrong-key",))
+
+
+async def test_dashboard_served():
+    """The ops dashboard (reference dashboard/ subsystem) is served by the
+    gateway: / → SPA shell, /ui/* → assets, no API key required for statics."""
+    async with GwStack() as s:
+        r = await s.client.get("/")
+        assert r.status == 200
+        html = await r.text()
+        assert "Cordum TPU" in html and "/ui/app.js" in html
+        for asset in ("/ui/app.js", "/ui/style.css"):
+            r = await s.client.get(asset)
+            assert r.status == 200, asset
+        js = await (await s.client.get("/ui/app.js")).text()
+        # every nav page the SPA declares exists in the bundle
+        for page in ("overview", "jobs", "approvals", "workflows", "runs",
+                     "dlq", "workers", "policy", "packs", "config", "settings"):
+            assert f"pages.{page}" in js, page
+
+
 async def test_context_endpoints():
     from cordum_tpu.context.service import ContextService
 
