@@ -99,7 +99,7 @@ SERVICES = [
      {"CORDUM_STATEBUS_URL": "statebus://127.0.0.1:7420"}),
     ("worker", "cordum_tpu.cmd.worker",
      {"CORDUM_STATEBUS_URL": "statebus://127.0.0.1:7420",
-      "WORKER_TOPICS": "job.tpu.>,job.default", "WORKER_POOL": "tpu"}),
+      "WORKER_TOPICS": "job.tpu.>,job.default,job.hello-pack.echo", "WORKER_POOL": "tpu"}),
 ]
 
 

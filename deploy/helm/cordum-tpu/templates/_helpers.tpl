@@ -1,0 +1,17 @@
+{{- define "cordum.name" -}}
+{{- .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "cordum.labels" -}}
+app.kubernetes.io/name: {{ include "cordum.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+{{- end -}}
+
+{{- define "cordum.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end -}}
+
+{{- define "cordum.statebusUrl" -}}
+statebus://{{ .Release.Name }}-statebus:7420
+{{- end -}}

@@ -32,7 +32,10 @@ KERNEL_PORT = int(os.environ.get("SMOKE_KERNEL_PORT", "7431"))
 GATEWAY_PORT = int(os.environ.get("SMOKE_GATEWAY_PORT", "8082"))
 API = f"http://127.0.0.1:{GATEWAY_PORT}"
 H_USER = {"X-Api-Key": "smoke-key"}
-H_ADMIN = {"X-Api-Key": "smoke-admin", "X-Principal-Id": "smoke-admin"}
+# X-Principal-Role covers dev open mode (no keys configured); with keys the
+# admin key itself grants the role and the header cannot escalate others
+H_ADMIN = {"X-Api-Key": "smoke-admin", "X-Principal-Id": "smoke-admin",
+           "X-Principal-Role": "admin"}
 
 
 def log(msg: str) -> None:
@@ -137,22 +140,39 @@ def wait_run(c: httpx.Client, run_id: str, want: str, timeout_s: float = 90.0) -
 
 def main() -> int:
     keep = "--keep" in sys.argv
-    logdir = tempfile.mkdtemp(prefix="cordum-smoke-")
-    log(f"logs: {logdir}")
-    procs = spawn_stack(logdir)
+    # SMOKE_BASE / BASE: target an already-running deployment (compose, k8s)
+    # instead of spawning the process stack — the deploy-parity mode used by
+    # docs/DEPLOY.md. Key overrides: SMOKE_API_KEY / SMOKE_ADMIN_KEY.
+    global API
+    external = os.environ.get("SMOKE_BASE") or os.environ.get("BASE")
+    if external:
+        API = external.rstrip("/")
+        H_USER["X-Api-Key"] = os.environ.get("SMOKE_API_KEY", H_USER["X-Api-Key"])
+        H_ADMIN["X-Api-Key"] = os.environ.get("SMOKE_ADMIN_KEY", H_ADMIN["X-Api-Key"])
+        procs, logdir = [], "(external)"
+        log(f"targeting external deployment {API}")
+    else:
+        logdir = tempfile.mkdtemp(prefix="cordum-smoke-")
+        log(f"logs: {logdir}")
+        procs = spawn_stack(logdir)
     try:
         wait_http(f"{API}/healthz")
         log("gateway is up")
         with httpx.Client(base_url=API, headers=H_USER, timeout=30.0) as c, \
              httpx.Client(base_url=API, headers=H_ADMIN, timeout=30.0) as admin:
             # worker registered?
+            want_worker = "smoke-w1" if not external else ""
             t0 = time.time()
+            workers = {}
             while time.time() - t0 < 60:
                 workers = c.get("/api/v1/workers").json().get("workers", {})
-                if "smoke-w1" in workers:
+                if (want_worker in workers) if want_worker else workers:
                     break
                 time.sleep(0.5)
-            assert "smoke-w1" in workers, f"worker never registered: {workers}"
+            if want_worker:
+                assert want_worker in workers, f"worker never registered: {workers}"
+            else:
+                assert workers, "no workers heartbeating in external deployment"
             log("worker registered with heartbeats")
 
             # 1. hello workflow end-to-end through the real worker
