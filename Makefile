@@ -2,6 +2,21 @@ PY ?= python
 
 .PHONY: test test-fast smoke bench up init dryrun lint
 
+# Static analysis gate: cordumlint always (stdlib-only), ruff + mypy-strict
+# when installed (the CI lint job installs both; minimal TPU images may not).
+lint:
+	$(PY) -m tools.cordumlint cordum_tpu bench.py
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check cordum_tpu tools bench.py; \
+	else echo "lint: ruff not installed; skipped (CI enforces it)"; fi
+	@if command -v mypy >/dev/null 2>&1; then \
+		mypy --strict cordum_tpu/protocol cordum_tpu/infra; \
+	else echo "lint: mypy not installed; skipped (CI enforces it)"; fi
+
+lint-baseline:
+	$(PY) -m tools.cordumlint cordum_tpu bench.py --write-baseline \
+		--justification "$(JUSTIFICATION)"
+
 test:
 	$(PY) -m pytest tests/ -q
 

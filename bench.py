@@ -89,7 +89,7 @@ async def bench_scheduler() -> dict:
             ),
         )
 
-    await bus.subscribe("worker.bench-w.jobs", worker_handler, queue="w")
+    await bus.subscribe(subj.direct_subject("bench-w"), worker_handler, queue="w")
 
     t0 = time.perf_counter()
     for i in range(N_JOBS):
@@ -139,7 +139,7 @@ async def bench_latency() -> dict:
             if len(done) >= PACED_JOBS:
                 all_done.set()
 
-    await bus.subscribe("worker.bench-w.jobs", worker_handler, queue="w")
+    await bus.subscribe(subj.direct_subject("bench-w"), worker_handler, queue="w")
     await bus.subscribe(subj.RESULT, result_tap)
 
     # pace in 10ms ticks to keep sleep() syscalls off the per-job path
@@ -311,7 +311,12 @@ def _jax_child(device: str) -> None:
 
 def bench_jax() -> dict:
     """Run the TPU bench child; fall back to a CPU child so the compute path
-    is still exercised when the TPU is unavailable (clearly labeled)."""
+    is still exercised when the TPU is unavailable (clearly labeled).
+
+    Child failures are NEVER silently degraded into a partial metric: the
+    full child traceback rides along in ``child_traceback`` and main() flags
+    the run ``degraded`` with a loud stderr warning (CL002 applied to the
+    bench harness)."""
     results: dict = {}
     for device in ("tpu", "cpu"):
         try:
@@ -326,10 +331,16 @@ def bench_jax() -> dict:
                 tail = (proc.stderr or proc.stdout or "")[-300:]
                 child = {"embed_error": f"child rc={proc.returncode}: {tail}",
                          "model_error": f"child rc={proc.returncode}"}
-        except subprocess.TimeoutExpired:
+            if ("embed_error" in child or "model_error" in child) and proc.stderr:
+                # full crash context, not just the one-line summary
+                child["child_traceback"] = proc.stderr[-8000:]
+        except subprocess.TimeoutExpired as te:
             child = {"embed_error": f"{device} bench timed out after {JAX_TIMEOUT_S}s "
                                     "(TPU grant unavailable?)",
                      "model_error": "timeout"}
+            partial = te.stderr.decode(errors="replace") if isinstance(te.stderr, bytes) else (te.stderr or "")
+            if partial:
+                child["child_traceback"] = partial[-8000:]
         except Exception as ex:  # noqa: BLE001
             child = {"embed_error": f"{type(ex).__name__}: {ex}"[:300]}
         if device == "tpu":
@@ -348,6 +359,8 @@ def bench_jax() -> dict:
                 if k not in results and k in child:
                     results[k] = child[k]
                     results["fallback_device"] = child.get("device", "cpu")
+            if "child_traceback" not in results and "child_traceback" in child:
+                results["child_traceback"] = child["child_traceback"]
     return results
 
 
@@ -382,6 +395,20 @@ def main() -> None:
     }
     if "fallback_device" in jx:
         out["fallback_device"] = jx["fallback_device"]
+    degraded = bool(out["embed_error"] or out["model_error"])
+    out["degraded"] = degraded
+    if degraded:
+        out["child_traceback"] = jx.get("child_traceback", "")
+        sys.stderr.write(
+            "\n*** BENCH DEGRADED: the JAX compute child failed — the control-"
+            "plane numbers above are healthy but embed/model metrics are "
+            "partial or missing. Child errors:\n"
+            f"    embed_error: {out['embed_error'] or '-'}\n"
+            f"    model_error: {out['model_error'] or '-'}\n"
+        )
+        if out["child_traceback"]:
+            sys.stderr.write("--- child traceback (tail) ---\n")
+            sys.stderr.write(out["child_traceback"][-2000:] + "\n")
     print(json.dumps(out))
 
 

@@ -165,8 +165,8 @@ def cmd_job(args) -> None:
 
 
 def _wait_job(c: httpx.Client, job_id: str, timeout_s: float = 120.0) -> None:
-    t0 = time.time()
-    while time.time() - t0 < timeout_s:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
         doc = _check(c.get(f"/api/v1/jobs/{job_id}?result=true"))
         state = doc.get("state", "")
         if state in ("SUCCEEDED", "FAILED", "CANCELLED", "TIMEOUT", "DENIED"):
@@ -219,8 +219,8 @@ def cmd_run(args) -> None:
 
 
 def _wait_run(c: httpx.Client, run_id: str, timeout_s: float = 300.0) -> None:
-    t0 = time.time()
-    while time.time() - t0 < timeout_s:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
         doc = _check(c.get(f"/api/v1/runs/{run_id}"))
         if doc.get("status") in ("SUCCEEDED", "FAILED", "CANCELLED"):
             _print(doc)

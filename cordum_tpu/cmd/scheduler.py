@@ -58,14 +58,16 @@ async def main() -> None:
     )
     snapshotter = WorkerSnapshotWriter(kv, registry)
 
-    pools_doc = {}
-    timeouts_doc = {}
-    if os.path.exists(cfg.pool_config_path):
-        with open(cfg.pool_config_path) as f:
-            pools_doc = yaml.safe_load(f) or {}
-    if os.path.exists(cfg.timeout_config_path):
-        with open(cfg.timeout_config_path) as f:
-            timeouts_doc = yaml.safe_load(f) or {}
+    def _load_yaml(path: str) -> dict:
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return yaml.safe_load(f) or {}
+
+    # config reads happen off the event loop (CL003): startup shares the
+    # loop with the bus connection heartbeats
+    pools_doc = await asyncio.to_thread(_load_yaml, cfg.pool_config_path)
+    timeouts_doc = await asyncio.to_thread(_load_yaml, cfg.timeout_config_path)
     await overlay.bootstrap(pools_doc, timeouts_doc)
 
     await engine.start()

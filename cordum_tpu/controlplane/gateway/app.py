@@ -258,7 +258,7 @@ class Gateway:
     # ------------------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 8081) -> None:
         self._subs.append(await self.bus.subscribe(subj.DLQ, self._tap_dlq))
-        self._subs.append(await self.bus.subscribe("sys.job.>", self._tap_events))
+        self._subs.append(await self.bus.subscribe(subj.JOB_EVENTS_WILDCARD, self._tap_events))
         self._subs.append(await self.bus.subscribe(subj.WORKFLOW_EVENT, self._tap_events))
         if self.registry is not None:
             self._subs.append(await self.bus.subscribe(subj.HEARTBEAT, self._tap_heartbeat))
@@ -393,7 +393,10 @@ class Gateway:
         await self.bus.publish(
             subj.SUBMIT, BusPacket.wrap(req, trace_id=trace_id, sender_id=self.instance_id)
         )
-        return web.json_response({"job_id": job_id, "trace_id": trace_id, "state": "PENDING"}, status=202)
+        return web.json_response(
+            {"job_id": job_id, "trace_id": trace_id, "state": JobState.PENDING.value},
+            status=202,
+        )
 
     async def get_job(self, request: web.Request) -> web.Response:
         job_id = request.match_info["job_id"]

@@ -31,6 +31,15 @@ DEFAULT_CACHE_TTL_S = 5.0
 MAX_SNAPSHOTS = 10
 
 
+def _read_file(path: str) -> Optional[bytes]:
+    """Read a policy artifact; None when absent (callers fail closed)."""
+    try:
+        with open(path, "rb") as f:  # cordumlint: disable=CL003 -- runs via asyncio.to_thread
+            return f.read()
+    except FileNotFoundError:
+        return None
+
+
 def _policy_hash(doc: dict) -> str:
     canonical = json.dumps(doc, sort_keys=True, separators=(",", ":"), default=str)
     return hashlib.sha256(canonical.encode()).hexdigest()
@@ -82,11 +91,9 @@ class SafetyKernel:
         # or disabled fragments' tenants/rules would persist across reloads
         doc = copy.deepcopy(self._file_doc)
         if self._policy_path:
-            try:
-                with open(self._policy_path, "rb") as f:
-                    raw = f.read()
-            except FileNotFoundError:
-                raw = None
+            import asyncio
+
+            raw = await asyncio.to_thread(_read_file, self._policy_path)
             if self._public_key_path:
                 # Signed mode: a missing file fails closed exactly like a bad
                 # signature — deleting/mis-pathing the file must not silently
@@ -94,14 +101,10 @@ class SafetyKernel:
                 # merge below so configsvc policy updates keep applying.
                 verified = False
                 if raw is not None:
-                    try:
-                        with open(self._policy_path + ".sig", "rb") as f:
-                            sig = f.read()
-                        with open(self._public_key_path, "rb") as f:
-                            pub = f.read()
+                    sig = await asyncio.to_thread(_read_file, self._policy_path + ".sig")
+                    pub = await asyncio.to_thread(_read_file, self._public_key_path)
+                    if sig is not None and pub is not None:
                         verified = verify_signature(raw, sig, pub)
-                    except FileNotFoundError:
-                        verified = False
                 if verified:
                     doc = yaml.safe_load(raw) or {}
                     self._last_verified_doc = copy.deepcopy(doc)
@@ -274,13 +277,21 @@ class SafetyKernel:
 def verify_signature(policy_bytes: bytes, signature: bytes, public_key_bytes: bytes) -> bool:
     """Ed25519 signature check for signed policy bundles
     (reference kernel.go:832-868).  Uses the cryptography backend when
-    available; otherwise rejects (fail closed)."""
+    available, else the pure-Python verifier in ``utils.ed25519`` — a
+    missing crypto library must not silently disable signed-policy
+    enforcement on minimal worker images.  Any verification failure
+    returns False (callers fail closed)."""
     try:
         from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
+    except ImportError:
+        from ...utils.ed25519 import verify as _pure_verify
 
+        return _pure_verify(public_key_bytes, signature, policy_bytes)
+    try:
         Ed25519PublicKey.from_public_bytes(public_key_bytes).verify(signature, policy_bytes)
         return True
-    except ImportError:
-        return False
-    except Exception:
+    except Exception as e:  # noqa: BLE001 - bad sig/key/encoding all deny
+        import logging as _l
+
+        _l.getLogger("cordum").debug("policy signature rejected: %s", e)
         return False

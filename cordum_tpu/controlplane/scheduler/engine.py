@@ -33,6 +33,7 @@ from ...infra.metrics import Metrics
 from ...infra.registry import WorkerRegistry
 from ...protocol import subjects as subj
 from ...protocol.jobhash import job_hash
+from ...utils.ids import now_us
 from ...protocol.types import (
     BusPacket,
     Constraints,
@@ -262,7 +263,7 @@ class Engine:
         self.metrics.jobs_dispatched.inc(topic=req.topic)
         sub_us = int(meta.get("submitted_at_us", "0") or 0)
         if sub_us:
-            self.metrics.dispatch_latency.observe(max(0.0, time.time() - sub_us / 1e6))
+            self.metrics.dispatch_latency.observe(max(0.0, (now_us() - sub_us) / 1e6))
 
     # ------------------------------------------------------------------
     async def redispatch_scheduled(self, job_id: str) -> bool:
@@ -417,7 +418,7 @@ class Engine:
         meta = await self.job_store.get_meta(res.job_id)
         sub_us = int(meta.get("submitted_at_us", "0") or 0)
         if sub_us:
-            self.metrics.e2e_latency.observe(max(0.0, time.time() - sub_us / 1e6))
+            self.metrics.e2e_latency.observe(max(0.0, (now_us() - sub_us) / 1e6))
         if state in (JobState.FAILED, JobState.TIMEOUT):
             req = await self.job_store.get_request(res.job_id)
             if req is not None:
@@ -434,8 +435,8 @@ class Engine:
             await self.job_store.set_state(
                 req.job_id, JobState.FAILED, fields={"error_message": reason}, event="dlq"
             )
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 - job may already be terminal
+            logx.warn("could not mark job FAILED before DLQ", job_id=req.job_id, err=str(e))
         await self._emit_dlq(req, reason, code, status=JobState.FAILED.value)
 
     async def _emit_dlq(self, req: JobRequest, reason: str, code: str, *, status: str) -> None:

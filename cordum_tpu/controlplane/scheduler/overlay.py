@@ -75,10 +75,7 @@ class ConfigOverlay:
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await logx.join_task(self._task, name="config-overlay")
             self._task = None
 
     async def _loop(self) -> None:
@@ -121,8 +118,5 @@ class WorkerSnapshotWriter:
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await logx.join_task(self._task, name="worker-snapshot-writer")
             self._task = None

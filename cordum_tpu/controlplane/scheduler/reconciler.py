@@ -12,13 +12,13 @@ JobRequest — unsticks submits lost to crashes between persist and dispatch.
 from __future__ import annotations
 
 import asyncio
-import time
 from typing import Optional
 
 from ...infra import logging as logx
 from ...infra.config import Timeouts
 from ...infra.jobstore import IllegalTransition, JobStore
 from ...protocol.types import JobState
+from ...utils.ids import now_ms, now_us
 from .engine import Engine
 
 BATCH = 200
@@ -45,10 +45,7 @@ class Reconciler:
         self._stop.set()
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await logx.join_task(self._task, name="reconciler")
             self._task = None
 
     async def _loop(self) -> None:
@@ -84,7 +81,7 @@ class Reconciler:
 
     async def _timeout_state(self, state: JobState, timeout_s: float) -> int:
         total = 0
-        cutoff_us = int((time.time() - timeout_s) * 1e6)
+        cutoff_us = now_us() - int(timeout_s * 1e6)
         for _ in range(MAX_ITERATIONS):
             stale = await self.job_store.list_by_state_older_than(state.value, cutoff_us, BATCH)
             if not stale:
@@ -108,8 +105,7 @@ class Reconciler:
         return total
 
     async def _expire_deadlines(self) -> int:
-        now_ms = int(time.time() * 1000)
-        expired = await self.job_store.expired_deadlines(now_ms, limit=BATCH)
+        expired = await self.job_store.expired_deadlines(now_ms(), limit=BATCH)
         n = 0
         for job_id in expired:
             await self.job_store.clear_deadline(job_id)
@@ -146,10 +142,7 @@ class PendingReplayer:
         self._stop.set()
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await logx.join_task(self._task, name="pending-replayer")
             self._task = None
 
     async def _loop(self) -> None:
@@ -164,7 +157,7 @@ class PendingReplayer:
                 pass
 
     async def run_once(self) -> int:
-        cutoff_us = int((time.time() - self.timeouts.dispatch_timeout_s) * 1e6)
+        cutoff_us = now_us() - int(self.timeouts.dispatch_timeout_s * 1e6)
         stuck = await self.job_store.list_by_state_older_than(
             JobState.PENDING.value, cutoff_us, BATCH
         )

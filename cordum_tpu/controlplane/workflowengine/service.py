@@ -57,10 +57,7 @@ class WorkflowEngineService:
         self._stop.set()
         if self._task:
             self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await logx.join_task(self._task, name="workflow-reconciler")
             self._task = None
 
     # ------------------------------------------------------------------

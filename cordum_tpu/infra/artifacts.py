@@ -28,7 +28,7 @@ class ArtifactMetadata:
 
 
 class ArtifactStore:
-    def __init__(self, kv: KV):
+    def __init__(self, kv: KV) -> None:
         self.kv = kv
 
     async def put(

@@ -44,7 +44,7 @@ class RetryAfter(Exception):
     """Raise from a durable-subject handler to request redelivery after a
     delay (reference scheduler/retry.go:9-47)."""
 
-    def __init__(self, delay_s: float, reason: str = ""):
+    def __init__(self, delay_s: float, reason: str = "") -> None:
         super().__init__(reason or f"retry after {delay_s}s")
         self.delay_s = delay_s
 
@@ -108,7 +108,7 @@ class Bus:
 
 
 class Subscription:
-    def __init__(self, unsub: Callable[[], None]):
+    def __init__(self, unsub: Callable[[], None]) -> None:
         self._unsub = unsub
 
     def unsubscribe(self) -> None:
@@ -124,7 +124,7 @@ class LoopbackBus(Bus):
     ``sync=True`` delivers inline in ``publish`` (deterministic unit tests).
     """
 
-    def __init__(self, *, sync: bool = False, durable: bool = True):
+    def __init__(self, *, sync: bool = False, durable: bool = True) -> None:
         self._subs: list[_Subscription] = []
         self._sid = itertools.count(1)
         self._rr: dict[tuple[str, str], int] = {}

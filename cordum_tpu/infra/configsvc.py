@@ -32,7 +32,7 @@ class ConfigDoc:
 
 
 class ConfigService:
-    def __init__(self, kv: KV):
+    def __init__(self, kv: KV) -> None:
         self.kv = kv
 
     async def get(self, scope: str, doc_id: str) -> Optional[ConfigDoc]:

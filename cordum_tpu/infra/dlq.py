@@ -31,7 +31,7 @@ INDEX_KEY = "dlq:index"
 
 
 class DLQStore:
-    def __init__(self, kv: KV):
+    def __init__(self, kv: KV) -> None:
         self.kv = kv
 
     async def add(self, e: DLQEntry) -> None:

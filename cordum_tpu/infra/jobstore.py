@@ -37,7 +37,7 @@ EVENTS_CAP = 200
 
 
 class IllegalTransition(Exception):
-    def __init__(self, job_id: str, prev: str, nxt: str):
+    def __init__(self, job_id: str, prev: str, nxt: str) -> None:
         super().__init__(f"job {job_id}: illegal transition {prev or '<none>'} -> {nxt}")
         self.prev = prev
         self.next = nxt
@@ -92,7 +92,7 @@ class ApprovalRecord:
 
 
 class JobStore:
-    def __init__(self, kv: KV, *, meta_ttl_s: float = DEFAULT_META_TTL_S):
+    def __init__(self, kv: KV, *, meta_ttl_s: float = DEFAULT_META_TTL_S) -> None:
         self.kv = kv
         self.meta_ttl_s = meta_ttl_s
 

@@ -152,7 +152,7 @@ class KV:
 class _Entry:
     __slots__ = ("value", "expires_at", "version")
 
-    def __init__(self, value: Any, expires_at: Optional[float], version: int):
+    def __init__(self, value: Any, expires_at: Optional[float], version: int) -> None:
         self.value = value
         self.expires_at = expires_at
         self.version = version

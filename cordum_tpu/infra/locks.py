@@ -3,10 +3,10 @@
 from __future__ import annotations
 
 import json
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..utils.ids import now_s
 from .kv import KV
 
 
@@ -22,7 +22,7 @@ def lock_key(resource: str) -> str:
 
 
 class LockStore:
-    def __init__(self, kv: KV):
+    def __init__(self, kv: KV) -> None:
         self.kv = kv
 
     async def _load(self, resource: str) -> Optional[LockInfo]:
@@ -30,14 +30,14 @@ class LockStore:
         if not b:
             return None
         info = LockInfo(**json.loads(b))
-        now = time.time()
+        now = now_s()
         info.owners = {o: exp for o, exp in info.owners.items() if exp > now}
         if not info.owners:
             return None
         return info
 
     async def _store(self, info: LockInfo) -> None:
-        max_ttl = max(info.owners.values()) - time.time() if info.owners else 0
+        max_ttl = max(info.owners.values()) - now_s() if info.owners else 0
         if max_ttl <= 0:
             await self.kv.delete(lock_key(info.resource))
             return
@@ -47,7 +47,7 @@ class LockStore:
         self, resource: str, owner: str, *, mode: str = "exclusive", ttl_s: float = 30.0
     ) -> bool:
         info = await self._load(resource)
-        exp = time.time() + ttl_s
+        exp = now_s() + ttl_s
         if info is None:
             await self._store(LockInfo(resource=resource, mode=mode, owners={owner: exp}))
             return True
@@ -73,7 +73,7 @@ class LockStore:
         info = await self._load(resource)
         if info is None or owner not in info.owners:
             return False
-        info.owners[owner] = time.time() + ttl_s
+        info.owners[owner] = now_s() + ttl_s
         await self._store(info)
         return True
 

@@ -13,7 +13,7 @@ _root = logging.getLogger("cordum")
 
 
 class _KVFormatter(logging.Formatter):
-    def __init__(self, json_mode: bool):
+    def __init__(self, json_mode: bool) -> None:
         super().__init__()
         self.json_mode = json_mode
 
@@ -63,3 +63,17 @@ def warn(msg: str, **kv: Any) -> None:
 
 def error(msg: str, **kv: Any) -> None:
     _log(logging.ERROR, msg, **kv)
+
+
+async def join_task(task: Any, *, name: str) -> None:
+    """Await a just-cancelled background task.  Cancellation is the expected
+    outcome; any other exception is a real crash that must not vanish in a
+    ``stop()`` (CL002) — it is logged with the task name."""
+    import asyncio
+
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass
+    except Exception as e:  # noqa: BLE001 - logged, never swallowed
+        error("background task crashed during shutdown", task=name, err=str(e))

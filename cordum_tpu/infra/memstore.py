@@ -15,7 +15,7 @@ DEFAULT_DATA_TTL_S = 24 * 3600.0
 
 
 class MemoryStore:
-    def __init__(self, kv: KV, *, data_ttl_s: float = DEFAULT_DATA_TTL_S):
+    def __init__(self, kv: KV, *, data_ttl_s: float = DEFAULT_DATA_TTL_S) -> None:
         self.kv = kv
         self.data_ttl_s = data_ttl_s
 

@@ -19,7 +19,7 @@ def _fmt_labels(labels: dict[str, str]) -> str:
 
 
 class Counter:
-    def __init__(self, name: str, help_: str = ""):
+    def __init__(self, name: str, help_: str = "") -> None:
         self.name = name
         self.help = help_
         self._values: dict[tuple, float] = {}
@@ -56,7 +56,7 @@ class Gauge(Counter):
 
 
 class Histogram:
-    def __init__(self, name: str, help_: str = "", buckets: tuple = _DEFAULT_BUCKETS):
+    def __init__(self, name: str, help_: str = "", buckets: tuple = _DEFAULT_BUCKETS) -> None:
         self.name = name
         self.help = help_
         self.buckets = buckets

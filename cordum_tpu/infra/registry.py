@@ -22,7 +22,7 @@ class WorkerInfo:
 
 
 class WorkerRegistry:
-    def __init__(self, ttl_s: float = DEFAULT_TTL_S):
+    def __init__(self, ttl_s: float = DEFAULT_TTL_S) -> None:
         self.ttl_s = ttl_s
         self._workers: dict[str, WorkerInfo] = {}
         self.version = 0  # bumped on every mutation (packed-scan cache key)

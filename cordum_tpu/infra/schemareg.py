@@ -19,7 +19,7 @@ class SchemaError(Exception):
 
 
 class SchemaRegistry:
-    def __init__(self, kv: KV):
+    def __init__(self, kv: KV) -> None:
         self.kv = kv
 
     async def put(self, schema_id: str, schema: dict[str, Any]) -> None:

@@ -38,6 +38,12 @@ from .kv import KV, MemoryKV
 
 _LEN = struct.Struct(">I")
 
+
+def _read_bytes(path: str) -> bytes:
+    """Sync AOF read; callers run it via asyncio.to_thread (CL003)."""
+    with open(path, "rb") as f:  # cordumlint: disable=CL003 -- runs via asyncio.to_thread
+        return f.read()
+
 # KV ops forwarded verbatim to the MemoryKV engine (name → is_mutation)
 _KV_OPS = {
     "get": False, "set": True, "setnx": True, "delete": True, "expire": True,
@@ -75,7 +81,7 @@ def _plain(v: Any) -> Any:
 class StateBusServer:
     """The server process: KV engine + subscription routing + AOF."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 7420, *, aof_path: str = ""):
+    def __init__(self, host: str = "127.0.0.1", port: int = 7420, *, aof_path: str = "") -> None:
         self.host = host
         self.port = port
         self.kv = MemoryKV()
@@ -95,7 +101,7 @@ class StateBusServer:
     async def start(self) -> None:
         if self.aof_path:
             await self._replay_aof()
-            self._aof = open(self.aof_path, "ab")
+            self._aof = await asyncio.to_thread(open, self.aof_path, "ab")
         self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
@@ -121,15 +127,16 @@ class StateBusServer:
         if not os.path.exists(self.aof_path):
             return
         n = 0
-        with open(self.aof_path, "rb") as f:
-            unpacker = msgpack.Unpacker(f, raw=False, strict_map_key=False)
-            for entry in unpacker:
-                op, args = entry[0], entry[1:]
-                try:
-                    await getattr(self.kv, op)(*args)
-                    n += 1
-                except Exception:
-                    logx.warn("aof replay skipped bad entry", op=op)
+        raw = await asyncio.to_thread(_read_bytes, self.aof_path)
+        unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
+        unpacker.feed(raw)
+        for entry in unpacker:
+            op, args = entry[0], entry[1:]
+            try:
+                await getattr(self.kv, op)(*args)
+                n += 1
+            except Exception:
+                logx.warn("aof replay skipped bad entry", op=op)
         logx.info("aof replayed", entries=n)
 
     def _log_aof(self, op: str, args: tuple) -> None:
@@ -194,8 +201,8 @@ class StateBusServer:
         except Exception as e:  # noqa: BLE001
             try:
                 await self._send(writer, [req_id, "err", str(e)])
-            except Exception:
-                pass
+            except Exception as send_err:  # noqa: BLE001 - peer already gone
+                logx.debug("could not deliver error reply", err=str(send_err))
 
     async def _route(self, subject: str, packet_bytes: bytes) -> None:
         from ..protocol import subjects as subj
@@ -232,8 +239,8 @@ class StateBusServer:
         for sid, w in plain:
             try:
                 await self._send(w, [0, "msg", sid, subject, packet_bytes])
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 - one dead peer must not stop fanout
+                logx.debug("dropping subscriber mid-fanout", sid=sid, err=str(e))
 
 
 class StateBusConn:
@@ -248,7 +255,7 @@ class StateBusConn:
     """
 
     def __init__(self, host: str, port: int, *, reconnect: bool = True,
-                 max_backoff_s: float = 2.0):
+                 max_backoff_s: float = 2.0) -> None:
         self.host = host
         self.port = port
         self._reader: Optional[asyncio.StreamReader] = None
@@ -451,19 +458,19 @@ def _maybe_bytes(v: Any) -> Any:
 class StateBusKV(KV):
     """KV interface over a statebus connection."""
 
-    def __init__(self, conn: StateBusConn):
+    def __init__(self, conn: StateBusConn) -> None:
         self.conn = conn
 
     async def close(self) -> None:
         await self.conn.close()
 
 
-def _make_kv_method(op: str):
+def _make_kv_method(op: str) -> Any:
     import inspect
 
     sig = inspect.signature(getattr(MemoryKV, op))
 
-    async def method(self, *args, **kwargs):
+    async def method(self: "StateBusKV", *args: Any, **kwargs: Any) -> Any:
         if kwargs:  # server applies ops positionally: bind kwargs through
             bound = sig.bind(self, *args, **kwargs)
             bound.apply_defaults()
@@ -500,7 +507,7 @@ class StateBusBus(Bus):
     """Bus interface over a statebus connection, with client-side RetryAfter
     redelivery (at-least-once on durable subjects)."""
 
-    def __init__(self, conn: StateBusConn):
+    def __init__(self, conn: StateBusConn) -> None:
         self.conn = conn
 
     async def publish(self, subject: str, pkt: BusPacket) -> None:

@@ -27,11 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-
+from ..parallel.compat import axis_size, shard_map_compat
 from ..parallel.mesh import AXIS_DP, AXIS_PP
 from .llama import LlamaConfig, rms_norm, rope
 
@@ -126,10 +122,14 @@ def _stage_apply(stage_params: dict, x: jax.Array, positions: jax.Array, base: L
 
 
 def _pipeline_local(params: dict, tokens_mb: jax.Array, cfg: PipelineConfig,
-                    *, pp_axis: str, dp_axis: str) -> jax.Array:
-    """Per-device body: tokens_mb [M, mb_local, T] → scalar mean loss."""
+                    *, pp_axis: str, dp_axis: str) -> tuple[jax.Array, jax.Array]:
+    """Per-device body: tokens_mb [M, mb_local, T] → ([1,1] loss sum, [1,1]
+    token count).  The cross-device reduction happens OUTSIDE the shard_map:
+    claiming a replicated scalar output (out_specs=P()) requires replication
+    tracking that older jax cannot prove through the fori_loop, so each
+    device returns its mapped partial sums instead."""
     base = cfg.base
-    s = jax.lax.axis_size(pp_axis)
+    s = axis_size(pp_axis)
     stage = jax.lax.axis_index(pp_axis)
     m, mb, t = tokens_mb.shape
     d = base.d_model
@@ -158,34 +158,37 @@ def _pipeline_local(params: dict, tokens_mb: jax.Array, cfg: PipelineConfig,
         logits = (h @ params["lm_head"]).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
         nll = -jnp.take_along_axis(logp, tgt_mb[:, 1:][..., None], axis=-1)[..., 0]
-        loss_sum = loss_sum + jnp.where(valid_out, jnp.sum(nll), 0.0)
-        tok_count = tok_count + jnp.where(valid_out, nll.size, 0)
+        # accumulate as [1,1] (never rank 0): scalar residuals of the grad
+        # partial-eval are mishandled by older jax's shard_map
+        valid = valid_out.astype(jnp.float32).reshape(1, 1)
+        loss_sum = loss_sum + valid * jnp.sum(nll, keepdims=True)
+        tok_count = tok_count + valid * float(nll.size)
         recv = jax.lax.ppermute(y, pp_axis, perm)
         return recv, loss_sum, tok_count
 
     recv0 = jnp.zeros((mb, t, d), base.dtype)
+    zero11 = jnp.zeros((1, 1), jnp.float32)
     _, loss_sum, tok_count = jax.lax.fori_loop(
-        0, n_ticks, tick, (recv0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
+        0, n_ticks, tick, (recv0, zero11, zero11)
     )
-    # broadcast loss to all stages / dp ranks
-    loss_sum = jax.lax.psum(loss_sum, (pp_axis, dp_axis))
-    tok_count = jax.lax.psum(tok_count, (pp_axis, dp_axis))
-    return loss_sum / jnp.maximum(tok_count.astype(jnp.float32), 1.0)
+    return loss_sum, tok_count
 
 
 def make_loss_fn(cfg: PipelineConfig, mesh: Mesh, *, pp_axis: str = AXIS_PP, dp_axis: str = AXIS_DP):
     pspecs = param_specs(cfg)
     tok_spec = P(None, dp_axis, None)  # [M, mb, T], mb sharded over dp
+    part_spec = P(dp_axis, pp_axis)  # per-device [1,1] partial sums
 
     def loss(params, tokens_mb):
-        fn = _shard_map(
+        fn = shard_map_compat(
             partial(_pipeline_local, cfg=cfg, pp_axis=pp_axis, dp_axis=dp_axis),
             mesh=mesh,
             in_specs=(pspecs, tok_spec),
-            out_specs=P(),
+            out_specs=(part_spec, part_spec),
             check_vma=False,
         )
-        return fn(params, tokens_mb)
+        loss_sums, tok_counts = fn(params, tokens_mb)
+        return jnp.sum(loss_sums) / jnp.maximum(jnp.sum(tok_counts), 1.0)
 
     return loss
 
@@ -205,9 +208,11 @@ def make_train_step(cfg: PipelineConfig, mesh: Mesh, optimizer=None):
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    jstep = jax.jit(step, in_shardings=(param_shardings, None, tok_sharding),
-                    out_shardings=(param_shardings, None, None),
-                    donate_argnums=(0, 1))
+    from ..parallel.compat import donated_train_step
+
+    jstep = donated_train_step(
+        step, mesh=mesh, param_shardings=param_shardings, batch_sharding=tok_sharding
+    )
 
     def init(key):
         params = init_params(key, cfg)

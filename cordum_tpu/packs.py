@@ -286,8 +286,8 @@ class PackInstaller:
         if self.kernel is not None:
             try:
                 await self.kernel.reload()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 - rollback must not mask install error
+                logx.error("kernel reload failed after pack rollback", err=str(e))
 
     # -- uninstall -------------------------------------------------------
     async def uninstall(self, pack_id: str) -> bool:

@@ -1,0 +1,129 @@
+"""JAX version-compat shims for the model/ops layer.
+
+JAX renames and removes keyword arguments across minor releases faster than
+TPU pod fleets upgrade (the multi-pod version-skew problem, cf. MPMD pipeline
+parallelism deployments).  Passing a version-gated kwarg straight into
+``shard_map``/``jit`` therefore breaks whole test tiers when the installed
+jax predates (or postdates) the kwarg — e.g. ``check_vma`` landed as the
+rename of ``check_rep``, so jax 0.4.x raises ``TypeError`` on it.
+
+All ``shard_map`` call sites in this repo go through :func:`shard_map_compat`
+so exactly one module knows about the skew.  cordumlint rule CL006 enforces
+this: version-gated kwargs passed to ``shard_map``/``jit`` outside this
+module are flagged.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+import jax
+
+try:  # jax >= 0.7 exposes shard_map at top level
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a mapped mesh axis, inside a ``shard_map`` body.
+
+    ``jax.lax.axis_size`` only exists in newer jax; on older releases
+    ``psum(1, axis)`` constant-folds to the same static int.
+    """
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return int(fn(axis_name))
+    return int(jax.lax.psum(1, axis_name))
+
+# kwarg renames, newest name first: {new_name: old_name}
+_SHARD_MAP_RENAMES = {"check_vma": "check_rep"}
+
+_accepted_cache: frozenset[str] | None = None
+
+
+def _shard_map_accepted_kwargs() -> frozenset[str]:
+    """Keyword names the installed ``shard_map`` accepts (cached)."""
+    global _accepted_cache
+    if _accepted_cache is None:
+        try:
+            params = inspect.signature(_shard_map).parameters
+            if any(p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()):
+                _accepted_cache = frozenset(params) | frozenset(_SHARD_MAP_RENAMES)
+            else:
+                _accepted_cache = frozenset(params)
+        except (TypeError, ValueError):  # signature unavailable: pass-through
+            _accepted_cache = frozenset(_SHARD_MAP_RENAMES) | frozenset(
+                _SHARD_MAP_RENAMES.values()
+            )
+    return _accepted_cache
+
+
+def donated_train_step(
+    step: Callable[..., Any],
+    *,
+    mesh: Any,
+    param_shardings: Any,
+    batch_sharding: Any,
+) -> Callable[..., Any]:
+    """``jit(step, donate_argnums=(0, 1))`` with optimizer-state shardings
+    pinned to the concrete first-call value.
+
+    With ``out_shardings=None`` the compiler may pick a different layout for
+    a donated opt-state buffer than its input had; newer jax silently skips
+    the alias, but older jaxlibs (0.4.x) crash at dispatch with an INTERNAL
+    aliased-buffer size mismatch.  Deriving the opt-state shardings from the
+    real value and pinning them on both sides makes every donated alias
+    exact on every jax version.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    jitted: Callable[..., Any] | None = None
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def _pin(x: Any) -> Any:
+        # keep mesh-native shardings (mu/nu mirror the param shardings);
+        # anything else (uncommitted scalars like the adam step count) is
+        # pinned replicated so in==out and the donated alias is exact
+        s = getattr(x, "sharding", None)
+        if isinstance(s, NamedSharding) and s.mesh == mesh:
+            return s
+        return replicated
+
+    def wrapper(params: Any, opt_state: Any, batch: Any) -> Any:
+        nonlocal jitted
+        if jitted is None:
+            opt_shardings = jax.tree.map(_pin, opt_state)
+            jitted = jax.jit(
+                step,
+                in_shardings=(param_shardings, opt_shardings, batch_sharding),
+                out_shardings=(param_shardings, opt_shardings, None),
+                donate_argnums=(0, 1),
+            )
+        return jitted(params, opt_state, batch)
+
+    return wrapper
+
+
+def shard_map_compat(f: Callable[..., Any], **kwargs: Any) -> Callable[..., Any]:
+    """``shard_map`` that tolerates kwarg skew across jax versions.
+
+    Version-gated kwargs (currently ``check_vma``/``check_rep``) are
+    translated to whatever the installed jax accepts, or dropped when the
+    concept does not exist there at all.  Core kwargs (``mesh``,
+    ``in_specs``, ``out_specs``) pass through untouched.
+    """
+    accepted = _shard_map_accepted_kwargs()
+    call_kwargs: dict[str, Any] = {}
+    for name, value in kwargs.items():
+        if name in accepted:
+            call_kwargs[name] = value
+            continue
+        old = _SHARD_MAP_RENAMES.get(name)
+        if old is not None and old in accepted:
+            call_kwargs[old] = value
+        elif name in _SHARD_MAP_RENAMES or name in _SHARD_MAP_RENAMES.values():
+            continue  # concept absent in this jax: drop rather than crash
+        else:
+            call_kwargs[name] = value  # unknown kwarg: surface the TypeError
+    return _shard_map(f, **call_kwargs)

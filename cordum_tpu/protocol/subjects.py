@@ -8,6 +8,7 @@ PROGRESS = "sys.job.progress"
 CANCEL = "sys.job.cancel"
 DLQ = "sys.job.dlq"
 WORKFLOW_EVENT = "sys.workflow.event"
+JOB_EVENTS_WILDCARD = "sys.job.>"  # every job lifecycle event (gateway tap)
 
 JOB_PREFIX = "job."
 WORKER_PREFIX = "worker."

@@ -20,7 +20,9 @@ from __future__ import annotations
 import dataclasses
 import enum
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Optional, TypeVar
+
+_W = TypeVar("_W", bound="WireModel")
 
 import msgpack
 
@@ -199,7 +201,7 @@ class WireModel:
         return _to_plain(self)
 
     @classmethod
-    def from_dict(cls, d: dict[str, Any] | None):
+    def from_dict(cls: type[_W], d: dict[str, Any] | None) -> Optional[_W]:
         if d is None:
             return None
         kwargs: dict[str, Any] = {}
@@ -217,7 +219,7 @@ class WireModel:
         return msgpack.packb(self.to_dict(), use_bin_type=True)
 
     @classmethod
-    def from_wire(cls, b: bytes):
+    def from_wire(cls: type[_W], b: bytes) -> Optional[_W]:
         return cls.from_dict(msgpack.unpackb(b, raw=False))
 
 
@@ -452,7 +454,7 @@ class BusPacket(WireModel):
         return d
 
     @classmethod
-    def from_dict(cls, d: dict[str, Any] | None):
+    def from_dict(cls, d: dict[str, Any] | None) -> Optional["BusPacket"]:
         if d is None:
             return None
         kind = d.get("kind", "")

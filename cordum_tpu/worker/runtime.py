@@ -143,8 +143,10 @@ class Worker:
             self._hb_task.cancel()
             try:
                 await self._hb_task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception as e:  # noqa: BLE001 - logged, never swallowed
+                logx.warn("heartbeat loop crashed during shutdown", err=str(e))
         for s in self._subs:
             s.unsubscribe()
         self._subs = []

@@ -9,10 +9,18 @@ POLICY = b"default_tenant: default\ntenants:\n  default:\n    allow_topics: ['jo
 
 
 def make_keys():
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
-    from cryptography.hazmat.primitives.serialization import (
-        Encoding, PublicFormat,
-    )
+    """(signer, raw-32-byte pubkey) via the cryptography backend when
+    installed, else the pure-Python fallback the kernel also verifies with."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, PublicFormat,
+        )
+    except ImportError:
+        from cordum_tpu.utils.ed25519 import SigningKey
+
+        priv = SigningKey()
+        return priv, priv.public_key_bytes()
 
     priv = Ed25519PrivateKey.generate()
     pub = priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)

@@ -1,0 +1,74 @@
+"""Baseline: grandfather existing findings without blessing new ones.
+
+A baseline entry is a content-addressed fingerprint — ``sha1(rule | path |
+normalized offending line | occurrence index)`` — so entries survive
+unrelated edits (line shifts, renames elsewhere) but invalidate when the
+offending line itself changes, forcing a re-decision.  Every entry carries
+a human justification; ``--write-baseline`` refuses to run without one.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+
+
+def _fingerprints(findings: Iterable[Finding]) -> list[tuple[str, Finding]]:
+    """Fingerprint each finding, disambiguating identical lines in one file
+    by occurrence order."""
+    seen: Counter[str] = Counter()
+    out: list[tuple[str, Finding]] = []
+    for f in findings:
+        key = f"{f.rule_id}|{f.path}|{f.snippet.strip()}"
+        occurrence = seen[key]
+        seen[key] += 1
+        fp = hashlib.sha1(f.fingerprint_input(occurrence).encode()).hexdigest()[:16]
+        out.append((fp, f))
+    return out
+
+
+def load(path: Path) -> dict:
+    if not path.exists():
+        return {"version": BASELINE_VERSION, "entries": {}}
+    doc = json.loads(path.read_text(encoding="utf-8"))
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {doc.get('version')}, "
+            f"expected {BASELINE_VERSION}"
+        )
+    return doc
+
+
+def write(path: Path, findings: Iterable[Finding], justification: str) -> int:
+    """Record every finding as grandfathered; returns the entry count."""
+    entries = {}
+    for fp, f in _fingerprints(findings):
+        entries[fp] = {
+            "rule": f.rule_id,
+            "path": f.path,
+            "line": f.line,
+            "snippet": f.snippet.strip(),
+            "justification": justification,
+        }
+    doc = {"version": BASELINE_VERSION, "entries": entries}
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n", encoding="utf-8")
+    return len(entries)
+
+
+def apply(findings: list[Finding], baseline_doc: dict) -> list[Finding]:
+    """Mark findings present in the baseline (``baselined=True``) so the
+    reporter can separate new violations from grandfathered ones."""
+    entries = baseline_doc.get("entries", {})
+    out: list[Finding] = []
+    for fp, f in _fingerprints(findings):
+        if fp in entries:
+            f = Finding(**{**f.to_dict(), "baselined": True})
+        out.append(f)
+    return out

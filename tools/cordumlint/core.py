@@ -1,0 +1,245 @@
+"""Rule engine: file walking, AST context, inline suppression, dispatch.
+
+A :class:`Rule` inspects one file at a time through a :class:`LintContext`
+(source, parsed AST with parent links, per-line suppression markers) and
+yields :class:`Finding` objects.  The engine owns everything rule-agnostic:
+collecting Python files, parsing, honoring ``# cordumlint: disable=...``
+comments, per-rule enablement, and path allow-lists from the config.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import re
+from pathlib import Path
+from typing import Iterable, Iterator, Optional
+
+_DISABLE_RE = re.compile(
+    r"#\s*cordumlint:\s*disable=(?P<codes>[A-Za-z0-9,\s]+?|all)\s*(?:--\s*(?P<reason>.*))?$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a concrete source location."""
+
+    rule_id: str
+    path: str  # repo-relative, forward slashes
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+    snippet: str = ""
+    baselined: bool = False
+
+    def fingerprint_input(self, occurrence: int) -> str:
+        """Stable identity: rule + path + normalized line text + occurrence
+        index among identical lines — survives unrelated line-number shifts."""
+        return f"{self.rule_id}|{self.path}|{self.snippet.strip()}|{occurrence}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class LintContext:
+    """Everything a rule needs to inspect one file."""
+
+    def __init__(self, path: Path, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self._disabled = self._collect_suppressions()
+
+    # ------------------------------------------------------------------
+    def _collect_suppressions(self) -> dict[int, frozenset[str]]:
+        """Map line number -> rule ids disabled there (`all` = every rule).
+        A marker suppresses its own line and, when the line holds nothing
+        but the comment, the line below."""
+        disabled: dict[int, frozenset[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _DISABLE_RE.search(line)
+            if not m:
+                continue
+            raw = m.group("codes")
+            codes = frozenset(
+                c.strip().upper() for c in raw.split(",") if c.strip()
+            ) if raw != "all" else frozenset({"ALL"})
+            disabled[i] = disabled.get(i, frozenset()) | codes
+            if line.strip().startswith("#"):  # standalone marker covers next line
+                disabled[i + 1] = disabled.get(i + 1, frozenset()) | codes
+        return disabled
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        codes = self._disabled.get(line, frozenset())
+        return "ALL" in codes or rule_id.upper() in codes
+
+    # ------------------------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_statement(self, node: ast.AST) -> ast.stmt:
+        """Innermost ``ast.stmt`` containing ``node`` (or node itself)."""
+        best = node
+        for anc in [node, *self.ancestors(node)]:
+            if isinstance(anc, ast.stmt):
+                best = anc
+                break
+        return best  # type: ignore[return-value]
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def statement_text(self, node: ast.AST) -> str:
+        stmt = self.enclosing_statement(node)
+        return ast.get_source_segment(self.source, stmt) or ""
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+class Rule:
+    """Base class.  Subclasses set ``id``/``name``/``description`` and
+    implement :meth:`check`; ``default_allow_paths`` lists repo-relative
+    globs where the rule never fires (the module that legitimately owns
+    the flagged construct)."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+    default_allow_paths: tuple[str, ...] = ()
+
+    def __init__(self, options: Optional[dict] = None):
+        self.options = options or {}
+        self.allow_paths: tuple[str, ...] = tuple(
+            self.options.get("allow_paths", self.default_allow_paths)
+        )
+
+    def path_allowed(self, rel_path: str) -> bool:
+        return any(fnmatch.fnmatch(rel_path, pat) for pat in self.allow_paths)
+
+    def check(self, ctx: LintContext) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        if self.path_allowed(ctx.rel_path):
+            return
+        for finding in self.check(ctx):
+            if not ctx.is_suppressed(self.id, finding.line):
+                yield finding
+
+    # -- helpers shared by rules ---------------------------------------
+    def finding(self, ctx: LintContext, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(
+            rule_id=self.id,
+            path=ctx.rel_path,
+            line=line,
+            col=col,
+            message=message,
+            snippet=ctx.line_text(line).strip(),
+        )
+
+
+def all_rules(config: Optional[dict] = None) -> list[Rule]:
+    """Instantiate every registered rule honoring per-rule config
+    (``{"rules": {"CL001": {"enabled": false, ...}}}``)."""
+    from . import rules as rules_mod
+
+    cfg = (config or {}).get("rules", {})
+    out: list[Rule] = []
+    for cls in rules_mod.RULES:
+        opts = cfg.get(cls.id, {})
+        if not opts.get("enabled", True):
+            continue
+        out.append(cls(opts))
+    return out
+
+
+DEFAULT_EXCLUDES = (
+    "*/.git/*",
+    "*/__pycache__/*",
+    "*/node_modules/*",
+    "*/.venv/*",
+)
+
+
+def collect_files(paths: Iterable[str], root: Path, excludes: Iterable[str]) -> list[Path]:
+    files: list[Path] = []
+    patterns = tuple(excludes) + DEFAULT_EXCLUDES
+    for p in paths:
+        path = (root / p) if not Path(p).is_absolute() else Path(p)
+        if path.is_file() and path.suffix == ".py":
+            files.append(path)
+        elif path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+    out = []
+    for f in files:
+        rel = _rel(f, root)
+        if any(fnmatch.fnmatch(rel, pat) or fnmatch.fnmatch("/" + rel, pat) for pat in patterns):
+            continue
+        out.append(f)
+    return out
+
+
+def _rel(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]
+    files_checked: int
+    parse_errors: list[str]
+
+
+def lint_paths(
+    paths: Iterable[str],
+    *,
+    root: Path,
+    config: Optional[dict] = None,
+    select: Optional[set[str]] = None,
+    ignore: Optional[set[str]] = None,
+) -> LintResult:
+    """Lint ``paths`` (files or directories) and return every finding."""
+    config = config or {}
+    rules = all_rules(config)
+    if select:
+        rules = [r for r in rules if r.id in select]
+    if ignore:
+        rules = [r for r in rules if r.id not in ignore]
+    findings: list[Finding] = []
+    parse_errors: list[str] = []
+    files = collect_files(paths, root, config.get("exclude", ()))
+    for f in files:
+        rel = _rel(f, root)
+        try:
+            source = f.read_text(encoding="utf-8")
+            ctx = LintContext(f, rel, source)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            parse_errors.append(f"{rel}: {type(e).__name__}: {e}")
+            continue
+        for rule in rules:
+            findings.extend(rule.run(ctx))
+    findings.sort(key=lambda fi: (fi.path, fi.line, fi.col, fi.rule_id))
+    return LintResult(findings=findings, files_checked=len(files), parse_errors=parse_errors)

@@ -1,0 +1,51 @@
+"""Reporters: human text and machine JSON."""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import IO
+
+from .core import LintResult
+
+
+def text_report(
+    result: LintResult, *, stream: IO[str], show_baselined: bool = False
+) -> None:
+    shown = [
+        f for f in result.findings if show_baselined or not f.baselined
+    ]
+    for f in shown:
+        tag = " [baselined]" if f.baselined else ""
+        stream.write(f"{f.path}:{f.line}:{f.col + 1}: {f.rule_id}{tag} {f.message}\n")
+        if f.snippet:
+            stream.write(f"    {f.snippet}\n")
+    active = [f for f in result.findings if not f.baselined]
+    baselined = len(result.findings) - len(active)
+    by_rule = Counter(f.rule_id for f in active)
+    summary = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items())) or "clean"
+    stream.write(
+        f"\n{len(active)} finding(s) in {result.files_checked} file(s)"
+        f" ({baselined} baselined) — {summary}\n"
+    )
+    for err in result.parse_errors:
+        stream.write(f"parse error: {err}\n")
+
+
+def json_report(
+    result: LintResult, *, stream: IO[str], show_baselined: bool = False
+) -> None:
+    active = [f for f in result.findings if not f.baselined]
+    doc = {
+        "files_checked": result.files_checked,
+        "findings": [
+            f.to_dict()
+            for f in result.findings
+            if show_baselined or not f.baselined
+        ],
+        "summary": dict(Counter(f.rule_id for f in active)),
+        "active_count": len(active),
+        "baselined_count": len(result.findings) - len(active),
+        "parse_errors": result.parse_errors,
+    }
+    json.dump(doc, stream, indent=2, sort_keys=True)
+    stream.write("\n")
