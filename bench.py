@@ -85,7 +85,7 @@ async def bench_scheduler() -> dict:
             subj.RESULT,
             BusPacket.wrap(
                 JobResult(job_id=req.job_id, status="SUCCEEDED", worker_id="bench-w"),
-                sender_id="bench-w",
+                trace_id=pkt.trace_id, sender_id="bench-w", span_id=pkt.span_id,
             ),
         )
 
@@ -111,7 +111,10 @@ async def bench_scheduler() -> dict:
 
 async def bench_latency() -> dict:
     """Open-loop paced submission at PACED_RATE jobs/s offered load, exact
-    submit→result latency per job (raw list, not a capped histogram)."""
+    submit→result latency per job (raw list, not a capped histogram), plus a
+    per-stage breakdown derived from the flight-recorder spans the pipeline
+    publishes on ``sys.trace.span``."""
+    from cordum_tpu.obs.tracer import Tracer
     from cordum_tpu.protocol import subjects as subj
     from cordum_tpu.protocol.types import BusPacket, JobRequest, JobResult
 
@@ -121,14 +124,19 @@ async def bench_latency() -> dict:
     done: dict[str, float] = {}
     submitted: dict[str, float] = {}
     all_done = asyncio.Event()
+    wtracer = Tracer("worker", bus)
 
     async def worker_handler(subject, pkt):
         req = pkt.job_request
+        async with wtracer.span(
+            "execute", trace_id=pkt.trace_id, parent_span_id=pkt.span_id
+        ) as sp:
+            pass  # zero-work execute: the span bounds result-publish timing
         await bus.publish(
             subj.RESULT,
             BusPacket.wrap(
                 JobResult(job_id=req.job_id, status="SUCCEEDED", worker_id="bench-w"),
-                sender_id="bench-w",
+                trace_id=pkt.trace_id, sender_id="bench-w", span_id=sp.span_id,
             ),
         )
 
@@ -139,8 +147,18 @@ async def bench_latency() -> dict:
             if len(done) >= PACED_JOBS:
                 all_done.set()
 
+    # stage breakdown straight from the span stream (exact durations, no
+    # bucketing) — the same data the collector would persist
+    stage_samples: dict[str, list[float]] = {}
+
+    async def span_tap(subject, pkt):
+        sp = pkt.span
+        if sp is not None:
+            stage_samples.setdefault(sp.name, []).append(sp.duration_us / 1000.0)
+
     await bus.subscribe(subj.direct_subject("bench-w"), worker_handler, queue="w")
     await bus.subscribe(subj.RESULT, result_tap)
+    await bus.subscribe(subj.TRACE_SPAN, span_tap)
 
     # pace in 10ms ticks to keep sleep() syscalls off the per-job path
     tick = 0.010
@@ -175,12 +193,25 @@ async def bench_latency() -> dict:
     def q(p: float) -> float:
         return lat[min(len(lat) - 1, int(p * len(lat)))] * 1000
 
+    # per-stage p50s from the span stream (ISSUE stage names → bench keys)
+    def stage_p50(name: str) -> float:
+        vals = sorted(stage_samples.get(name, []))
+        return vals[len(vals) // 2] if vals else 0.0
+
+    stages = {
+        "policy": stage_p50("policy-check"),
+        "schedule": stage_p50("schedule"),
+        "dispatch": stage_p50("dispatch"),
+        "execute": stage_p50("execute"),
+        "result_publish": stage_p50("result"),
+    }
     return {
         "paced_completed": len(lat),
         "paced_offered_rate": PACED_JOBS / offered_dt,
         "p50_e2e_ms": q(0.50),
         "p90_e2e_ms": q(0.90),
         "p99_e2e_ms": q(0.99),
+        "stage_p50_ms": {k: round(v, 3) for k, v in stages.items()},
     }
 
 
@@ -380,6 +411,7 @@ def main() -> None:
         "jobs": sched["jobs"],
         "p50_e2e_ms": round(lat.get("p50_e2e_ms", 0.0), 2),
         "p99_e2e_ms": round(lat.get("p99_e2e_ms", 0.0), 2),
+        "stage_p50_ms": lat.get("stage_p50_ms", {}),
         "paced_rate_offered": round(lat.get("paced_offered_rate", 0.0), 1),
         "paced_completed": lat.get("paced_completed", 0),
         "selections_per_sec": round(sel["selections_per_sec"], 1),

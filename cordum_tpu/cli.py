@@ -1,5 +1,5 @@
 """cordumctl — the operator CLI (reference ``cmd/cordumctl``, ~2.9k LoC:
-init/dev/up/status/workflow/run/approval/dlq/pack/job).
+init/dev/up/status/workflow/run/approval/dlq/pack/job/trace).
 
 Talks HTTP to the gateway (env CORDUM_API_URL, CORDUM_API_KEY); ``up``
 spawns the full service stack as local subprocesses.
@@ -246,8 +246,28 @@ def cmd_dlq(args) -> None:
             _print(_check(c.get("/api/v1/dlq")))
         elif args.action == "retry":
             _print(_check(c.post(f"/api/v1/dlq/{args.job_id}/retry")))
+        elif args.action == "retry-all":
+            _print(_check(c.post("/api/v1/dlq/retry-all")))
+        elif args.action == "purge":
+            _print(_check(c.post("/api/v1/dlq/purge",
+                                 json={"max_age_s": args.max_age_s})))
         elif args.action == "delete":
             _print(_check(c.delete(f"/api/v1/dlq/{args.job_id}")))
+
+
+def cmd_trace(args) -> None:
+    """Fetch a trace and render the flight-recorder span waterfall."""
+    from .obs.assembler import render_waterfall
+
+    with _client() as c:
+        doc = _check(c.get(f"/api/v1/traces/{args.trace_id}"))
+    if args.json:
+        _print(doc)
+        return
+    print(render_waterfall(doc, width=args.width))
+    jobs = doc.get("jobs") or []
+    if jobs:
+        print("jobs: " + "  ".join(f"{j['job_id']}={j.get('state')}" for j in jobs))
 
 
 def cmd_pack(args) -> None:
@@ -311,9 +331,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_approval)
 
     sp = sub.add_parser("dlq")
-    sp.add_argument("action", choices=["list", "retry", "delete"])
+    sp.add_argument("action", choices=["list", "retry", "retry-all", "purge", "delete"])
     sp.add_argument("job_id", nargs="?")
+    sp.add_argument("--max-age-s", dest="max_age_s", type=float, default=0.0,
+                    help="purge: drop entries older than this many seconds")
     sp.set_defaults(fn=cmd_dlq)
+
+    sp = sub.add_parser("trace", help="render a trace's span waterfall")
+    sp.add_argument("trace_id")
+    sp.add_argument("--json", action="store_true", help="raw JSON instead of ASCII")
+    sp.add_argument("--width", type=int, default=48)
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("pack")
     sp.add_argument("action", choices=["create", "install", "uninstall", "list", "show", "verify"])

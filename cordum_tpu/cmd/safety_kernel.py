@@ -14,11 +14,16 @@ async def main() -> None:
     cfg = _boot.setup()
     configsvc = None
     conn = None
+    tracer = None
     if cfg.statebus_url:
         kv, bus, conn = await _boot.connect_statebus(cfg)
         configsvc = ConfigService(kv)
+        from ..obs.tracer import Tracer
+
+        tracer = Tracer("safety-kernel", bus)
     kernel = SafetyKernel(policy_path=cfg.safety_policy_path, configsvc=configsvc)
-    svc = KernelService(kernel, reload_interval_s=_boot.env_float("SAFETY_RELOAD_INTERVAL", 30.0))
+    svc = KernelService(kernel, reload_interval_s=_boot.env_float("SAFETY_RELOAD_INTERVAL", 30.0),
+                        tracer=tracer)
     host = os.environ.get("SAFETY_KERNEL_HOST", "127.0.0.1")
     port = _boot.env_int("SAFETY_KERNEL_PORT", 7430)
     await svc.start(host, port)

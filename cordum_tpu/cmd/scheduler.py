@@ -37,9 +37,14 @@ async def main() -> None:
 
     kernel_addr = cfg.safety_kernel_addr
     if kernel_addr:
+        # remote kernel: span context rides the RPC headers; the kernel
+        # service emits its own evaluate spans
         check_fn = remote_check(kernel_addr)
     else:  # embedded kernel (single-binary deployments)
-        kernel = SafetyKernel(policy_path=cfg.safety_policy_path, configsvc=configsvc)
+        from ..obs.tracer import Tracer
+
+        kernel = SafetyKernel(policy_path=cfg.safety_policy_path, configsvc=configsvc,
+                              tracer=Tracer("safety-kernel", bus))
         await kernel.reload()
         check_fn = kernel.check
     safety = SafetyClient(check_fn)

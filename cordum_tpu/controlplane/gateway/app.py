@@ -44,6 +44,9 @@ from ...infra.metrics import Metrics
 from ...infra.registry import WorkerRegistry
 from ...infra.schemareg import SchemaError, SchemaRegistry
 from ...infra.secrets import contains_secret_refs
+from ...obs.assembler import assemble
+from ...obs.collector import SpanCollector
+from ...obs.tracer import Tracer
 from ...protocol import subjects as subj
 from ...protocol.jobhash import job_hash
 from ...protocol.types import (
@@ -112,6 +115,11 @@ class Gateway:
         self.artifacts = ArtifactStore(kv)
         self.auth = auth or BasicAuthProvider()
         self.metrics = metrics or Metrics()
+        self.tracer = Tracer("gateway", bus)
+        # the gateway hosts the deployment's span collector: it owns /metrics
+        # (stage histograms land there) and serves the trace API from the
+        # same KV the collector writes
+        self.span_collector = SpanCollector(kv, bus, metrics=self.metrics)
         self.rate = TokenBucket(rate_rps)
         self.max_concurrent_runs = max_concurrent_runs
         self.ws_allowed_origins = ws_allowed_origins
@@ -146,6 +154,8 @@ class Gateway:
         r.add_post(f"{v1}/runs/{{run_id}}/steps/{{step_id}}/approve", self.approve_step)
         r.add_get(f"{v1}/runs/{{run_id}}/timeline", self.run_timeline)
         r.add_get(f"{v1}/dlq", self.list_dlq)
+        r.add_post(f"{v1}/dlq/retry-all", self.retry_all_dlq)
+        r.add_post(f"{v1}/dlq/purge", self.purge_dlq)
         r.add_delete(f"{v1}/dlq/{{job_id}}", self.delete_dlq)
         r.add_post(f"{v1}/dlq/{{job_id}}/retry", self.retry_dlq)
         r.add_post(f"{v1}/policy/evaluate", self.policy_evaluate)
@@ -260,6 +270,7 @@ class Gateway:
         self._subs.append(await self.bus.subscribe(subj.DLQ, self._tap_dlq))
         self._subs.append(await self.bus.subscribe(subj.JOB_EVENTS_WILDCARD, self._tap_events))
         self._subs.append(await self.bus.subscribe(subj.WORKFLOW_EVENT, self._tap_events))
+        await self.span_collector.start()
         if self.registry is not None:
             self._subs.append(await self.bus.subscribe(subj.HEARTBEAT, self._tap_heartbeat))
         self._runner = web.AppRunner(self.app)
@@ -272,6 +283,7 @@ class Gateway:
         for s in self._subs:
             s.unsubscribe()
         self._subs = []
+        await self.span_collector.stop()
         for ws in list(self._ws_clients):
             await ws.close()
         if self._runner:
@@ -375,24 +387,35 @@ class Gateway:
             context_hints=hints,
         )
         trace_id = str(body.get("trace_id") or new_id())
-        await self.job_store.set_state(
-            job_id,
-            JobState.PENDING,
-            fields={
-                "topic": topic,
-                "tenant_id": tenant,
-                "principal_id": principal.principal_id,
-                "context_ptr": ctx_ptr,
-                "trace_id": trace_id,
-                "submitted_at_us": str(now_us()),
-            },
-            event="submit",
-        )
-        await self.job_store.put_request(req)
-        await self.job_store.add_to_trace(trace_id, job_id)
-        await self.bus.publish(
-            subj.SUBMIT, BusPacket.wrap(req, trace_id=trace_id, sender_id=self.instance_id)
-        )
+        # submit span: the trace root for API-submitted jobs; downstream
+        # scheduler/kernel/worker spans hang off the packet's span context
+        async with self.tracer.span(
+            "submit",
+            trace_id=trace_id,
+            attrs={"job_id": job_id, "topic": topic, "tenant_id": tenant},
+        ) as sp:
+            await self.job_store.set_state(
+                job_id,
+                JobState.PENDING,
+                fields={
+                    "topic": topic,
+                    "tenant_id": tenant,
+                    "principal_id": principal.principal_id,
+                    "context_ptr": ctx_ptr,
+                    "trace_id": trace_id,
+                    "submitted_at_us": str(now_us()),
+                },
+                event="submit",
+            )
+            await self.job_store.put_request(req)
+            await self.job_store.add_to_trace(trace_id, job_id)
+            await self.bus.publish(
+                subj.SUBMIT,
+                BusPacket.wrap(
+                    req, trace_id=trace_id, sender_id=self.instance_id,
+                    span_id=sp.span_id,
+                ),
+            )
         return web.json_response(
             {"job_id": job_id, "trace_id": trace_id, "state": JobState.PENDING.value},
             status=202,
@@ -690,14 +713,15 @@ class Gateway:
         ok = await self.dlq.delete(request.match_info["job_id"])
         return web.json_response({"deleted": ok}, status=200 if ok else 404)
 
-    async def retry_dlq(self, request: web.Request) -> web.Response:
-        """Retry a dead-lettered job under a NEW job id with rehydrated
-        context (reference gateway.go:3452)."""
-        job_id = request.match_info["job_id"]
+    async def _retry_dlq_job(self, job_id: str) -> Optional[str]:
+        """The per-job DLQ re-drive: NEW job id, rehydrated context, fresh
+        submit (reference gateway.go:3452).  Returns the new job id, or None
+        when the entry/original request is gone.  Shared by the single-job
+        route and ``retry-all``."""
         entry = await self.dlq.get(job_id)
         orig = await self.job_store.get_request(job_id)
         if entry is None or orig is None:
-            return _err(404, "job not found in DLQ")
+            return None
         new_jid = new_id()
         ctx = await self.mem.get_context(orig.context_ptr) if orig.context_ptr else None
         new_ptr = await self.mem.put_context(new_jid, ctx)
@@ -714,7 +738,47 @@ class Gateway:
         await self.job_store.put_request(req)
         await self.bus.publish(subj.SUBMIT, BusPacket.wrap(req, sender_id=self.instance_id))
         await self.dlq.delete(job_id)
+        return new_jid
+
+    async def retry_dlq(self, request: web.Request) -> web.Response:
+        job_id = request.match_info["job_id"]
+        new_jid = await self._retry_dlq_job(job_id)
+        if new_jid is None:
+            return _err(404, "job not found in DLQ")
         return web.json_response({"job_id": new_jid, "retried_from": job_id}, status=202)
+
+    async def retry_all_dlq(self, request: web.Request) -> web.Response:
+        """Re-drive every dead-lettered job via the per-job retry path
+        (admin: a bulk resubmit can flood the scheduler)."""
+        if (deny := self._require_admin(request)) is not None:
+            return deny
+        body = await request.json() if request.can_read_body else {}
+        results = await self.dlq.retry_all(
+            self._retry_dlq_job, limit=int((body or {}).get("limit", 0))
+        )
+        return web.json_response({
+            "retried": [
+                {"job_id": jid, "new_job_id": new} for jid, new in results if new
+            ],
+            "skipped": [jid for jid, new in results if not new],
+            "count": sum(1 for _, new in results if new),
+        }, status=202)
+
+    async def purge_dlq(self, request: web.Request) -> web.Response:
+        """Drop DLQ entries older than a cutoff: body ``{"older_than_us": N}``
+        or ``{"max_age_s": N}`` (admin: purging is irreversible)."""
+        if (deny := self._require_admin(request)) is not None:
+            return deny
+        body = await request.json() if request.can_read_body else {}
+        body = body or {}
+        if "older_than_us" in body:
+            cutoff = int(body["older_than_us"])
+        elif "max_age_s" in body:
+            cutoff = now_us() - int(float(body["max_age_s"]) * 1e6)
+        else:
+            return _err(400, "older_than_us or max_age_s is required")
+        purged = await self.dlq.purge_older_than(cutoff)
+        return web.json_response({"purged": purged})
 
     # ------------------------------------------------------------------
     # policy admin
@@ -1092,13 +1156,17 @@ class Gateway:
         return web.json_response({"ptr": ptr, "value": value})
 
     async def get_trace(self, request: web.Request) -> web.Response:
+        """Trace reader: job-id grouping (legacy shape) + the flight-recorder
+        span waterfall — tree, per-stage durations, critical path."""
         trace_id = request.match_info["trace_id"]
         job_ids = sorted(await self.job_store.trace(trace_id))
         jobs = []
         for jid in job_ids:
             meta = await self.job_store.get_meta(jid)
             jobs.append({"job_id": jid, "state": meta.get("state"), "topic": meta.get("topic")})
-        return web.json_response({"trace_id": trace_id, "jobs": jobs})
+        doc = assemble(trace_id, await self.span_collector.spans(trace_id))
+        doc["jobs"] = jobs
+        return web.json_response(doc)
 
     # ------------------------------------------------------------------
     # observability

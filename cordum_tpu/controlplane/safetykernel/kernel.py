@@ -61,7 +61,12 @@ class SafetyKernel:
         configsvc: Optional[ConfigService] = None,
         cache_ttl_s: float = DEFAULT_CACHE_TTL_S,
         public_key_path: str = "",
+        tracer: Optional[Any] = None,
     ):
+        # flight-recorder tracer (obs.Tracer) for embedded deployments; when
+        # the kernel runs behind KernelService the SERVICE owns the span so
+        # leave this unset there (one "evaluate" span per check either way)
+        self._tracer = tracer
         self._file_doc = policy_doc or {}
         self._policy_path = policy_path
         # signed bundles: when a pubkey is configured, the policy file must
@@ -211,7 +216,18 @@ class SafetyKernel:
         return hashlib.sha256(canonical.encode()).hexdigest() + "|" + self._snapshot_id
 
     async def check(self, req: PolicyCheckRequest) -> PolicyCheckResponse:
-        """Evaluate with decision cache (the hot path the scheduler calls)."""
+        """Evaluate with decision cache (the hot path the scheduler calls).
+        Emits an ``evaluate`` span (service ``safety-kernel``) when a tracer
+        is wired and an ambient trace context exists."""
+        if self._tracer is None:
+            return await self._check_cached(req)
+        async with self._tracer.span("evaluate", attrs={"topic": req.topic}) as sp:
+            resp = await self._check_cached(req)
+            sp.attrs["decision"] = resp.decision
+            sp.attrs["snapshot"] = self._snapshot_id
+            return resp
+
+    async def _check_cached(self, req: PolicyCheckRequest) -> PolicyCheckResponse:
         if not self._snapshot_id:
             await self.reload()
         key = self._cache_key(req)

@@ -17,14 +17,25 @@ import aiohttp
 from aiohttp import web
 
 from ...infra import logging as logx
+from ...obs.tracer import SPAN_HEADER, TRACE_HEADER, Tracer, trace_headers
 from ...protocol.types import PolicyCheckRequest, PolicyCheckResponse
 from .kernel import SafetyKernel
 
 
 class KernelService:
-    def __init__(self, kernel: SafetyKernel, *, reload_interval_s: float = 30.0):
+    def __init__(
+        self,
+        kernel: SafetyKernel,
+        *,
+        reload_interval_s: float = 30.0,
+        tracer: Optional[Tracer] = None,
+    ):
         self.kernel = kernel
         self.reload_interval_s = reload_interval_s
+        # span ownership: the service wraps each RPC check in an `evaluate`
+        # span using the caller's X-Cordum-Trace/Span-Id headers; the wrapped
+        # kernel should therefore NOT carry its own tracer
+        self.tracer = tracer
         self._runner: Optional[web.AppRunner] = None
         self._reload_task: Optional[asyncio.Task] = None
         app = web.Application()
@@ -64,7 +75,18 @@ class KernelService:
 
     async def _check(self, request: web.Request) -> web.Response:
         req = PolicyCheckRequest.from_dict(await request.json())
-        resp = await self.kernel.check(req)
+        trace_id = request.headers.get(TRACE_HEADER, "")
+        if self.tracer is not None and trace_id:
+            async with self.tracer.span(
+                "evaluate",
+                trace_id=trace_id,
+                parent_span_id=request.headers.get(SPAN_HEADER, ""),
+                attrs={"topic": req.topic if req else ""},
+            ) as sp:
+                resp = await self.kernel.check(req)
+                sp.attrs["decision"] = resp.decision
+        else:
+            resp = await self.kernel.check(req)
         return web.json_response(resp.to_dict())
 
     async def _evaluate(self, request: web.Request) -> web.Response:
@@ -101,7 +123,12 @@ def remote_check(base_url: str, *, timeout_s: float = 2.0):
             session["s"] = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=timeout_s)
             )
-        async with session["s"].post(f"{base_url}/v1/check", json=req.to_dict()) as r:
+        # span context rides HTTP headers (the RPC analogue of
+        # BusPacket.span_id) so the kernel-side evaluate span lands in the
+        # caller's trace
+        async with session["s"].post(
+            f"{base_url}/v1/check", json=req.to_dict(), headers=trace_headers()
+        ) as r:
             if r.status != 200:
                 raise RuntimeError(f"kernel returned HTTP {r.status}")
             return PolicyCheckResponse.from_dict(await r.json())

@@ -31,6 +31,7 @@ from ...infra.configsvc import ConfigService
 from ...infra.jobstore import JobStore, SafetyDecisionRecord
 from ...infra.metrics import Metrics
 from ...infra.registry import WorkerRegistry
+from ...obs.tracer import Tracer
 from ...protocol import subjects as subj
 from ...protocol.jobhash import job_hash
 from ...utils.ids import now_us
@@ -68,8 +69,10 @@ class Engine:
         instance_id: str = "scheduler-0",
         max_attempts: int = DEFAULT_MAX_ATTEMPTS,
         tenant_concurrency_limit: int = 0,
+        tracer: Optional[Tracer] = None,
     ):
         self.bus = bus
+        self.tracer = tracer or Tracer("scheduler", bus)
         self.job_store = job_store
         self.safety = safety
         self.strategy = strategy
@@ -126,9 +129,13 @@ class Engine:
         req = pkt.job_request
         if req is None or not req.job_id or not req.topic:
             return
-        await self.handle_job_request(req, trace_id=pkt.trace_id)
+        await self.handle_job_request(
+            req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
+        )
 
-    async def handle_job_request(self, req: JobRequest, *, trace_id: str = "") -> None:
+    async def handle_job_request(
+        self, req: JobRequest, *, trace_id: str = "", parent_span_id: str = ""
+    ) -> None:
         if not await self.job_store.acquire_job_lock(req.job_id, self.instance_id, ttl_s=30.0):
             raise RetryAfter(0.05, f"job {req.job_id} locked")
         try:
@@ -179,7 +186,16 @@ class Engine:
                 )
             if trace_id:
                 await self.job_store.add_to_trace(trace_id, req.job_id)
-            await self.process_job(req, trace_id=trace_id)
+            # schedule span: covers safety gate + strategy + dispatch; a
+            # RetryAfter (throttle / tenant limit) surfaces as an ERROR span
+            # with the exception type, then still drives redelivery
+            async with self.tracer.span(
+                "schedule",
+                trace_id=trace_id,
+                parent_span_id=parent_span_id,
+                attrs={"job_id": req.job_id, "topic": req.topic},
+            ):
+                await self.process_job(req, trace_id=trace_id)
         finally:
             await self.job_store.release_job_lock(req.job_id, self.instance_id)
 
@@ -188,7 +204,11 @@ class Engine:
         meta = await self.job_store.get_meta(req.job_id)
         await self._attach_effective_config(req)
 
-        resp = await self._check_safety(req)
+        async with self.tracer.span(
+            "policy-check", attrs={"job_id": req.job_id}
+        ) as polsp:
+            resp = await self._check_safety(req)
+            polsp.attrs["decision"] = resp.decision
         decision = resp.decision
 
         if decision == Decision.DENY.value:
@@ -252,14 +272,22 @@ class Engine:
             return
 
         # pick subject and dispatch
-        target = self.strategy.pick_subject(req)
-        await self.job_store.set_state(
-            req.job_id, JobState.SCHEDULED, fields={"dispatch_subject": target}, event="scheduled"
-        )
-        out = BusPacket.wrap(req, trace_id=trace_id, sender_id=self.instance_id)
-        await self.bus.publish(target, out)
-        await self.job_store.set_state(req.job_id, JobState.DISPATCHED, event="dispatched")
-        await self.job_store.set_state(req.job_id, JobState.RUNNING, event="running")
+        async with self.tracer.span("strategy", attrs={"job_id": req.job_id}) as stsp:
+            target = self.strategy.pick_subject(req)
+            stsp.attrs["target"] = target
+        async with self.tracer.span(
+            "dispatch", attrs={"job_id": req.job_id, "target": target}
+        ) as dsp:
+            await self.job_store.set_state(
+                req.job_id, JobState.SCHEDULED, fields={"dispatch_subject": target}, event="scheduled"
+            )
+            out = BusPacket.wrap(
+                req, trace_id=trace_id, sender_id=self.instance_id,
+                span_id=dsp.span_id, parent_span_id=dsp.parent_span_id,
+            )
+            await self.bus.publish(target, out)
+            await self.job_store.set_state(req.job_id, JobState.DISPATCHED, event="dispatched")
+            await self.job_store.set_state(req.job_id, JobState.RUNNING, event="running")
         self.metrics.jobs_dispatched.inc(topic=req.topic)
         sub_us = int(meta.get("submitted_at_us", "0") or 0)
         if sub_us:
@@ -391,9 +419,13 @@ class Engine:
         res = pkt.job_result
         if res is None or not res.job_id:
             return
-        await self.handle_job_result(res)
+        await self.handle_job_result(
+            res, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
+        )
 
-    async def handle_job_result(self, res: JobResult) -> None:
+    async def handle_job_result(
+        self, res: JobResult, *, trace_id: str = "", parent_span_id: str = ""
+    ) -> None:
         if await self.job_store.is_terminal(res.job_id):
             return  # already terminal: redelivery no-op
         try:
@@ -404,6 +436,15 @@ class Engine:
             # workers may send RUNNING status hints; record as event only
             await self.job_store.append_event(res.job_id, "result_hint", status=res.status)
             return
+        async with self.tracer.span(
+            "result",
+            trace_id=trace_id,
+            parent_span_id=parent_span_id,
+            attrs={"job_id": res.job_id, "status": state.value},
+        ):
+            await self._apply_terminal_result(res, state)
+
+    async def _apply_terminal_result(self, res: JobResult, state: JobState) -> None:
         fields = {
             "result_ptr": res.result_ptr,
             "worker_id": res.worker_id,

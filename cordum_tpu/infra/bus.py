@@ -58,6 +58,12 @@ def compute_msg_id(subject: str, pkt: BusPacket) -> str:
         override = labels.get(LABEL_BUS_MSG_ID)
         if override:
             return f"{subject}|{override}"
+    # spans: every span id is unique, so it IS the dedupe identity — two
+    # spans of one trace finishing in the same microsecond must not collide
+    # on the trace_id/created_at fall-through below
+    span_id = getattr(p, "span_id", "")
+    if span_id:
+        return f"{subject}|{pkt.kind}|{span_id}"
     job_id = getattr(p, "job_id", "")
     if job_id:
         # Approval republishes reuse the job_id on the submit subject and must

@@ -3,10 +3,15 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Awaitable, Callable, Optional
 
 from ..utils.ids import now_us
 from .kv import KV
+
+# per-job re-drive hook: takes a DLQ job id, returns the new job id when the
+# retry was published (the gateway's retry path), or None when it could not
+# be (missing original request etc.)
+RetryFn = Callable[[str], Awaitable[Optional[str]]]
 
 
 @dataclass
@@ -59,3 +64,32 @@ class DLQStore:
         n = await self.kv.delete(entry_key(job_id))
         await self.kv.zrem(INDEX_KEY, job_id)
         return n > 0
+
+    # ------------------------------------------------------------------
+    # bulk operations
+    # ------------------------------------------------------------------
+    async def retry_all(
+        self, retry_fn: RetryFn, *, limit: int = 0
+    ) -> list[tuple[str, Optional[str]]]:
+        """Re-drive every dead-lettered job through ``retry_fn`` (the
+        existing per-job retry path), oldest first.  Returns
+        ``[(job_id, new_job_id | None), ...]``; entries whose retry was
+        published are removed from the queue, failed re-drives stay."""
+        ids = await self.kv.zrange(INDEX_KEY, 0, (limit - 1) if limit else -1)
+        out: list[tuple[str, Optional[str]]] = []
+        for jid in ids:
+            new_id = await retry_fn(jid)
+            if new_id is not None:
+                await self.delete(jid)
+            out.append((jid, new_id))
+        return out
+
+    async def purge_older_than(self, cutoff_us: int) -> int:
+        """Drop every entry dead-lettered at or before ``cutoff_us``; returns
+        the number purged."""
+        ids = await self.kv.zrangebyscore(INDEX_KEY, 0, float(cutoff_us))
+        n = 0
+        for jid in ids:
+            if await self.delete(jid):
+                n += 1
+        return n

@@ -1,6 +1,13 @@
 """Prometheus-style metrics: counters, gauges, histograms with text
 exposition (reference ``core/infra/metrics/metrics.go``).  Dependency-free;
-the gateway/scheduler serve ``render()`` at ``/metrics``."""
+the gateway/scheduler serve ``render()`` at ``/metrics``.
+
+Thread-safety: ``observe()``/``inc()`` run on worker threads (executor
+handlers) while ``render()``/``quantile()`` run on the event loop, so every
+read takes the same lock the writers take and works on a snapshot — an
+unlocked read can see a histogram's bucket list mid-update and report
+totals that never existed.
+"""
 from __future__ import annotations
 
 import threading
@@ -22,7 +29,7 @@ class Counter:
     def __init__(self, name: str, help_: str = "") -> None:
         self.name = name
         self.help = help_
-        self._values: dict[tuple, float] = {}
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
         self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
@@ -31,13 +38,20 @@ class Counter:
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels: str) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0.0)
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def _snapshot(self) -> list[tuple[tuple[tuple[str, str], ...], float]]:
+        with self._lock:
+            return sorted(self._values.items())
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for key, v in sorted(self._values.items()):
+        items = self._snapshot()
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
-        if not self._values:
+        if not items:
             out.append(f"{self.name} 0")
         return out
 
@@ -50,19 +64,19 @@ class Gauge(Counter):
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for key, v in sorted(self._values.items()):
+        for key, v in self._snapshot():
             out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return out
 
 
 class Histogram:
-    def __init__(self, name: str, help_: str = "", buckets: tuple = _DEFAULT_BUCKETS) -> None:
+    def __init__(self, name: str, help_: str = "", buckets: tuple[float, ...] = _DEFAULT_BUCKETS) -> None:
         self.name = name
         self.help = help_
         self.buckets = buckets
-        self._counts: dict[tuple, list[int]] = {}
-        self._sums: dict[tuple, float] = {}
-        self._totals: dict[tuple, int] = {}
+        self._counts: dict[tuple[tuple[str, str], ...], list[int]] = {}
+        self._sums: dict[tuple[tuple[str, str], ...], float] = {}
+        self._totals: dict[tuple[tuple[str, str], ...], int] = {}
         self._lock = threading.Lock()
 
     def observe(self, value: float, **labels: str) -> None:
@@ -78,11 +92,12 @@ class Histogram:
     def quantile(self, q: float, **labels: str) -> Optional[float]:
         """Approximate quantile from bucket boundaries (observability only)."""
         key = tuple(sorted(labels.items()))
-        total = self._totals.get(key, 0)
-        if not total:
-            return None
+        with self._lock:
+            total = self._totals.get(key, 0)
+            if not total:
+                return None
+            counts = list(self._counts[key])
         target = q * total
-        counts = self._counts[key]
         for i, c in enumerate(counts):
             if c >= target:
                 return self.buckets[i]
@@ -90,18 +105,22 @@ class Histogram:
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        for key in sorted(self._totals):
+        with self._lock:
+            snap = [
+                (key, list(self._counts[key]), self._sums[key], self._totals[key])
+                for key in sorted(self._totals)
+            ]
+        for key, counts, sum_, total in snap:
             labels = dict(key)
-            counts = self._counts[key]
             for i, b in enumerate(self.buckets):
                 bl = dict(labels)
                 bl["le"] = repr(b)
                 out.append(f"{self.name}_bucket{_fmt_labels(bl)} {counts[i]}")
             bl = dict(labels)
             bl["le"] = "+Inf"
-            out.append(f"{self.name}_bucket{_fmt_labels(bl)} {self._totals[key]}")
-            out.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]}")
-            out.append(f"{self.name}_count{_fmt_labels(labels)} {self._totals[key]}")
+            out.append(f"{self.name}_bucket{_fmt_labels(bl)} {total}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} {sum_}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {total}")
         return out
 
 
@@ -120,6 +139,13 @@ class Metrics:
             "cordum_dispatch_seconds", "submit->dispatch latency"
         )
         self.e2e_latency = Histogram("cordum_job_e2e_seconds", "submit->result latency")
+        self.stage_seconds = Histogram(
+            "cordum_stage_seconds",
+            "Per-stage pipeline latency from flight-recorder spans",
+        )
+        self.spans_collected = Counter(
+            "cordum_spans_collected_total", "Spans persisted by the collector"
+        )
         self.policy_evals = Counter("cordum_policy_evals_total", "Safety kernel evaluations")
         self.workflow_steps = Counter("cordum_workflow_steps_total", "Workflow steps dispatched")
         self.workers_live = Gauge("cordum_workers_live", "Live workers in registry")
@@ -134,6 +160,8 @@ class Metrics:
             self.http_latency,
             self.dispatch_latency,
             self.e2e_latency,
+            self.stage_seconds,
+            self.spans_collected,
             self.policy_evals,
             self.workflow_steps,
             self.workers_live,

@@ -1,0 +1,29 @@
+"""Flight recorder: span-based distributed tracing for the control plane.
+
+Three pieces:
+
+* :mod:`tracer` — create/finish :class:`~cordum_tpu.protocol.types.Span`
+  objects, propagate span context through ``contextvars`` inside a process
+  and through ``BusPacket.span_id`` across processes, publish finished
+  spans on the durable ``sys.trace.span`` subject.
+* :mod:`collector` — bus consumer persisting spans to KV as per-trace ring
+  buffers with retention caps, feeding the ``cordum_stage_seconds``
+  histograms.
+* :mod:`assembler` — rebuild the span tree, compute per-stage durations and
+  the critical path, render ASCII waterfalls for the CLI.
+
+See docs/OBSERVABILITY.md for the end-to-end story.
+"""
+from __future__ import annotations
+
+from .assembler import assemble, render_waterfall
+from .collector import SpanCollector
+from .tracer import Tracer, current_trace_context
+
+__all__ = [
+    "SpanCollector",
+    "Tracer",
+    "assemble",
+    "current_trace_context",
+    "render_waterfall",
+]

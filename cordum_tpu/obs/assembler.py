@@ -1,0 +1,174 @@
+"""Trace assembler: span list → tree, per-stage durations, critical path.
+
+Pure functions over :class:`~cordum_tpu.protocol.types.Span` lists (and the
+JSON-safe dicts :func:`assemble` produces), so the gateway API, the CLI
+renderer, and bench.py all share one implementation.
+
+Stage semantics: a span's ``name`` IS its pipeline stage.  The canonical
+dispatch path is ``submit → policy-check (evaluate) → schedule → dispatch →
+execute → result``; ``device`` spans nest under ``execute`` and carry the
+TPU wall time around ``block_until_ready``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..protocol.types import Span
+
+# canonical ordering for stage tables (unknown names sort after, by name)
+STAGE_ORDER = (
+    "submit",
+    "step-dispatch",
+    "schedule",
+    "policy-check",
+    "evaluate",
+    "strategy",
+    "dispatch",
+    "execute",
+    "device",
+    "result",
+)
+
+
+def _stage_rank(name: str) -> tuple[int, str]:
+    try:
+        return (STAGE_ORDER.index(name), name)
+    except ValueError:
+        return (len(STAGE_ORDER), name)
+
+
+def assemble(trace_id: str, spans: list[Span]) -> dict[str, Any]:
+    """Rebuild the span tree and derive the trace's shape.
+
+    Returns a JSON-safe dict::
+
+        {trace_id, span_count, services, total_us,
+         spans: [{span_id, parent_span_id, name, service, start_us, end_us,
+                  duration_us, status, depth, attrs}, ...]   # start order
+         stages: {name: {"total_us": int, "count": int}},
+         critical_path: [span_id, ...], critical_path_us: int}
+
+    Orphan spans (parent not collected — ring-buffer eviction or a lost
+    publish) are treated as roots so a holed trace still renders.
+    """
+    spans = sorted(spans, key=lambda s: (s.start_us, s.end_us))
+    by_id = {s.span_id: s for s in spans}
+    children: dict[str, list[Span]] = {}
+    roots: list[Span] = []
+    for s in spans:
+        if s.parent_span_id and s.parent_span_id in by_id:
+            children.setdefault(s.parent_span_id, []).append(s)
+        else:
+            roots.append(s)
+
+    depth: dict[str, int] = {}
+    stack = [(r, 0) for r in reversed(roots)]
+    while stack:
+        node, d = stack.pop()
+        depth[node.span_id] = d
+        for c in reversed(children.get(node.span_id, [])):
+            stack.append((c, d + 1))
+
+    stages: dict[str, dict[str, int]] = {}
+    for s in spans:
+        st = stages.setdefault(s.name, {"total_us": 0, "count": 0})
+        st["total_us"] += s.duration_us
+        st["count"] += 1
+
+    path, path_us = _critical_path(roots, children)
+    total_us = 0
+    if spans:
+        total_us = max(s.end_us for s in spans) - min(s.start_us for s in spans)
+    return {
+        "trace_id": trace_id,
+        "span_count": len(spans),
+        "services": sorted({s.service for s in spans if s.service}),
+        "total_us": max(0, total_us),
+        "spans": [
+            {
+                "span_id": s.span_id,
+                "parent_span_id": s.parent_span_id,
+                "name": s.name,
+                "service": s.service,
+                "start_us": s.start_us,
+                "end_us": s.end_us,
+                "duration_us": s.duration_us,
+                "status": s.status,
+                "depth": depth.get(s.span_id, 0),
+                "attrs": dict(s.attrs),
+            }
+            for s in spans
+        ],
+        "stages": dict(sorted(stages.items(), key=lambda kv: _stage_rank(kv[0]))),
+        "critical_path": path,
+        "critical_path_us": path_us,
+    }
+
+
+def _critical_path(
+    roots: list[Span], children: dict[str, list[Span]]
+) -> tuple[list[str], int]:
+    """Chain from the earliest root to the latest-finishing descendant: at
+    each node follow the child whose ``end_us`` is greatest (the one the
+    trace actually waited on).  Returns (span ids, wall µs covered)."""
+    if not roots:
+        return [], 0
+    first = min(roots, key=lambda s: s.start_us)
+    start = first.start_us
+    end = first.end_us
+    path: list[str] = []
+    cur: Optional[Span] = first
+    while cur is not None:
+        path.append(cur.span_id)
+        end = max(end, cur.end_us)
+        kids = children.get(cur.span_id, [])
+        cur = max(kids, key=lambda s: (s.end_us, s.duration_us)) if kids else None
+    return path, max(0, end - start)
+
+
+# ---------------------------------------------------------------------------
+# ASCII waterfall (CLI `cordum trace <id>`)
+# ---------------------------------------------------------------------------
+
+
+def _fmt_ms(us: int) -> str:
+    return f"{us / 1000.0:.2f}ms"
+
+
+def render_waterfall(doc: dict[str, Any], width: int = 48) -> str:
+    """Render an :func:`assemble` document (or its JSON round-trip) as an
+    ASCII waterfall, one row per span in start order."""
+    rows = doc.get("spans") or []
+    if not rows:
+        return f"trace {doc.get('trace_id', '?')}: no spans collected"
+    t0 = min(r["start_us"] for r in rows)
+    total = max(1, int(doc.get("total_us") or 1))
+    crit = set(doc.get("critical_path") or [])
+    lines = [
+        f"trace {doc.get('trace_id', '?')}  "
+        f"{doc.get('span_count', len(rows))} spans  "
+        f"services: {', '.join(doc.get('services') or [])}  "
+        f"total {_fmt_ms(total)}  critical path {_fmt_ms(int(doc.get('critical_path_us') or 0))}"
+    ]
+    label_w = max(len(f"{r['depth'] * '  '}{r['name']}") for r in rows) + 2
+    svc_w = max((len(r["service"]) for r in rows), default=0) + 2
+    for r in rows:
+        label = f"{r['depth'] * '  '}{r['name']}".ljust(label_w)
+        svc = str(r["service"]).ljust(svc_w)
+        off = int((r["start_us"] - t0) * width / total)
+        bar_len = max(1, int(r["duration_us"] * width / total))
+        bar_len = min(bar_len, width - min(off, width - 1))
+        fill = "#" if r["span_id"] in crit else "="
+        bar = (" " * min(off, width - 1) + fill * bar_len).ljust(width)
+        mark = " !" if r.get("status") == "ERROR" else ""
+        lines.append(
+            f"{label}{svc}|{bar}| +{_fmt_ms(r['start_us'] - t0)} "
+            f"{_fmt_ms(r['duration_us'])}{mark}"
+        )
+    stages = doc.get("stages") or {}
+    if stages:
+        lines.append("stages: " + "  ".join(
+            f"{name}={_fmt_ms(st['total_us'])}" + (f" x{st['count']}" if st["count"] > 1 else "")
+            for name, st in stages.items()
+        ))
+    return "\n".join(lines)

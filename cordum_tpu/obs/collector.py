@@ -1,0 +1,123 @@
+"""Span collector: the flight recorder's persistence side.
+
+Consumes finished spans from the durable ``sys.trace.span`` subject (queue
+group ``cordum-span-collector`` — one collector instance per deployment
+persists each span) and stores them in KV as per-trace ring buffers:
+
+* ``trace:spans:<trace_id>`` — list of span JSON blobs, capped at
+  ``max_spans_per_trace`` (oldest spans fall off first) with a TTL so
+  abandoned traces expire;
+* ``trace:spans:index`` — z-set of trace ids scored by last-write µs; when
+  it exceeds ``max_traces`` the oldest traces are evicted wholesale.
+
+On persist the collector also feeds the ``cordum_stage_seconds{stage,
+service}`` histograms, which is how per-stage latency reaches ``/metrics``
+without every service double-observing locally.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..infra import logging as logx
+from ..infra.bus import Bus, Subscription
+from ..infra.kv import KV
+from ..infra.metrics import Metrics
+from ..protocol import subjects as subj
+from ..protocol.types import BusPacket, Span
+from ..utils.ids import now_us
+
+DEFAULT_MAX_SPANS_PER_TRACE = 512
+DEFAULT_MAX_TRACES = 2048
+DEFAULT_TRACE_TTL_S = 3600.0
+
+INDEX_KEY = "trace:spans:index"
+
+
+def spans_key(trace_id: str) -> str:
+    return f"trace:spans:{trace_id}"
+
+
+class SpanCollector:
+    def __init__(
+        self,
+        kv: KV,
+        bus: Bus,
+        *,
+        metrics: Optional[Metrics] = None,
+        max_spans_per_trace: int = DEFAULT_MAX_SPANS_PER_TRACE,
+        max_traces: int = DEFAULT_MAX_TRACES,
+        trace_ttl_s: float = DEFAULT_TRACE_TTL_S,
+    ) -> None:
+        self.kv = kv
+        self.bus = bus
+        self.metrics = metrics
+        self.max_spans_per_trace = max_spans_per_trace
+        self.max_traces = max_traces
+        self.trace_ttl_s = trace_ttl_s
+        self._sub: Optional[Subscription] = None
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        self._sub = await self.bus.subscribe(
+            subj.TRACE_SPAN, self._on_span, queue=subj.QUEUE_SPAN_COLLECTOR
+        )
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.unsubscribe()
+            self._sub = None
+
+    # ------------------------------------------------------------------
+    async def _on_span(self, subject: str, pkt: BusPacket) -> None:
+        sp = pkt.span
+        if sp is None or not sp.trace_id or not sp.span_id:
+            return
+        await self.add(sp)
+
+    async def add(self, sp: Span) -> None:
+        key = spans_key(sp.trace_id)
+        await self.kv.rpush(key, json.dumps(sp.to_dict(), sort_keys=True).encode())
+        # ring-buffer retention: keep the newest max_spans_per_trace
+        await self.kv.ltrim(key, -self.max_spans_per_trace, -1)
+        await self.kv.expire(key, self.trace_ttl_s)
+        await self.kv.zadd(INDEX_KEY, sp.trace_id, float(now_us()))
+        await self._evict_over_cap()
+        if self.metrics is not None:
+            self.metrics.spans_collected.inc(service=sp.service)
+            self.metrics.stage_seconds.observe(
+                sp.duration_us / 1e6, stage=sp.name, service=sp.service
+            )
+
+    async def _evict_over_cap(self) -> None:
+        over = await self.kv.zcard(INDEX_KEY) - self.max_traces
+        if over <= 0:
+            return
+        oldest = await self.kv.zrange(INDEX_KEY, 0, over - 1)
+        for tid in oldest:
+            await self.kv.delete(spans_key(tid))
+            await self.kv.zrem(INDEX_KEY, tid)
+        logx.debug("span collector evicted traces", count=len(oldest))
+
+    # ------------------------------------------------------------------
+    # read side (gateway trace API / bench)
+    # ------------------------------------------------------------------
+    async def spans(self, trace_id: str) -> list[Span]:
+        out: list[Span] = []
+        for b in await self.kv.lrange(spans_key(trace_id)):
+            try:
+                sp = Span.from_dict(json.loads(b))
+            except (ValueError, TypeError) as e:
+                logx.warn("undecodable span in trace", trace_id=trace_id, err=str(e))
+                continue
+            if sp is not None:
+                out.append(sp)
+        return out
+
+    async def purge_older_than(self, cutoff_us: int) -> int:
+        """Drop traces whose last span landed at or before ``cutoff_us``."""
+        stale = await self.kv.zrangebyscore(INDEX_KEY, 0, float(cutoff_us))
+        for tid in stale:
+            await self.kv.delete(spans_key(tid))
+            await self.kv.zrem(INDEX_KEY, tid)
+        return len(stale)

@@ -1,0 +1,142 @@
+"""Span creation + context propagation (the flight recorder's write side).
+
+A :class:`Tracer` is cheap and service-local: each control-plane service
+owns one (``Tracer("scheduler", bus)``) and wraps its hot-path segments in
+``async with tracer.span("policy-check"): ...``.  Span context flows two
+ways:
+
+* **in-process** — a ``contextvars.ContextVar`` holds the active
+  ``(trace_id, span_id)`` pair, so nested spans parent themselves
+  automatically (asyncio tasks inherit the context at creation time);
+* **cross-process** — publishers stamp ``BusPacket.span_id`` /
+  ``parent_span_id`` (see ``protocol/types.py``) and receivers pass
+  ``pkt.span_id`` as ``parent_span_id`` when they open their own span.
+
+Finished spans are published on the durable ``sys.trace.span`` subject,
+fire-and-forget: tracing must never fail the traced work, so publish errors
+are logged and swallowed.  Spans without a trace id are timed but not
+published (nothing to attach them to).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import AsyncIterator, Optional
+
+from ..infra import logging as logx
+from ..infra.bus import Bus
+from ..protocol import subjects as subj
+from ..protocol.types import SPAN_ERROR, SPAN_OK, BusPacket, Span
+from ..utils.ids import new_id, now_us
+
+# active (trace_id, span_id) for the current asyncio task tree
+_CTX: contextvars.ContextVar[tuple[str, str]] = contextvars.ContextVar(
+    "cordum_span_ctx", default=("", "")
+)
+
+
+def current_trace_context() -> tuple[str, str]:
+    """→ ``(trace_id, span_id)`` of the active span ("" when untraced).
+    Used to propagate context into side channels the bus doesn't carry,
+    e.g. the remote safety-kernel HTTP headers."""
+    return _CTX.get()
+
+
+TRACE_HEADER = "X-Cordum-Trace-Id"
+SPAN_HEADER = "X-Cordum-Span-Id"
+
+
+def trace_headers() -> dict[str, str]:
+    """HTTP header pair carrying the current span context (empty dict when
+    untraced) — the RPC-side analogue of ``BusPacket.span_id``."""
+    trace_id, span_id = _CTX.get()
+    if not trace_id:
+        return {}
+    return {TRACE_HEADER: trace_id, SPAN_HEADER: span_id}
+
+
+class Tracer:
+    """Service-local span factory + publisher."""
+
+    def __init__(self, service: str, bus: Optional[Bus] = None) -> None:
+        self.service = service
+        self.bus = bus
+
+    # ------------------------------------------------------------------
+    # primitives (for code whose control flow doesn't fit a CM, e.g. the
+    # worker's run-job state machine)
+    # ------------------------------------------------------------------
+    def begin(
+        self,
+        name: str,
+        *,
+        trace_id: str = "",
+        parent_span_id: str = "",
+        attrs: Optional[dict[str, str]] = None,
+    ) -> Span:
+        ctx_trace, ctx_span = _CTX.get()
+        tid = trace_id or ctx_trace
+        parent = parent_span_id
+        if not parent and ctx_span and tid == ctx_trace:
+            parent = ctx_span
+        return Span(
+            span_id=new_id(),
+            parent_span_id=parent,
+            trace_id=tid,
+            name=name,
+            service=self.service,
+            start_us=now_us(),
+            attrs=dict(attrs or {}),
+        )
+
+    async def finish(self, span: Span, *, status: str = SPAN_OK) -> None:
+        if not span.end_us:
+            span.end_us = now_us()
+        span.status = status
+        await self.emit(span)
+
+    async def emit(self, span: Span) -> None:
+        """Publish a finished span; never raises into the traced work."""
+        if self.bus is None or not span.trace_id:
+            return
+        try:
+            await self.bus.publish(
+                subj.TRACE_SPAN,
+                BusPacket.wrap(span, trace_id=span.trace_id, sender_id=self.service),
+            )
+        except Exception as e:  # noqa: BLE001 - tracing must not fail the work
+            logx.warn("span publish failed", span=span.name, err=str(e))
+
+    # ------------------------------------------------------------------
+    @contextlib.asynccontextmanager
+    async def span(
+        self,
+        name: str,
+        *,
+        trace_id: str = "",
+        parent_span_id: str = "",
+        attrs: Optional[dict[str, str]] = None,
+    ) -> AsyncIterator[Span]:
+        """Time the enclosed block as a span and publish it on exit.
+
+        The span becomes the ambient context for the block, so nested
+        ``tracer.span(...)`` calls (even in other services' code running in
+        this task) parent themselves under it.  Exceptions mark the span
+        ``ERROR`` with the exception type in ``attrs["error"]`` and are
+        re-raised untouched.
+        """
+        sp = self.begin(
+            name, trace_id=trace_id, parent_span_id=parent_span_id, attrs=attrs
+        )
+        token = _CTX.set((sp.trace_id, sp.span_id)) if sp.trace_id else None
+        status = SPAN_OK
+        try:
+            yield sp
+        except BaseException as e:
+            status = SPAN_ERROR
+            sp.attrs.setdefault("error", type(e).__name__)
+            raise
+        finally:
+            if token is not None:
+                _CTX.reset(token)
+            await self.finish(sp, status=status)

@@ -9,6 +9,7 @@ CANCEL = "sys.job.cancel"
 DLQ = "sys.job.dlq"
 WORKFLOW_EVENT = "sys.workflow.event"
 JOB_EVENTS_WILDCARD = "sys.job.>"  # every job lifecycle event (gateway tap)
+TRACE_SPAN = "sys.trace.span"  # finished flight-recorder spans → collector
 
 JOB_PREFIX = "job."
 WORKER_PREFIX = "worker."
@@ -16,6 +17,7 @@ WORKER_PREFIX = "worker."
 # Queue (consumer-group) names
 QUEUE_SCHEDULER = "cordum-scheduler"
 QUEUE_WORKFLOW_ENGINE = "cordum-workflow-engine"
+QUEUE_SPAN_COLLECTOR = "cordum-span-collector"
 
 
 def direct_subject(worker_id: str) -> str:
@@ -25,8 +27,9 @@ def direct_subject(worker_id: str) -> str:
 
 def is_durable_subject(subject: str) -> bool:
     """Subjects that get at-least-once semantics under the durable bus
-    (reference nats.go:369-381: submit/result/dlq/job.*/worker.*.jobs)."""
-    if subject in (SUBMIT, RESULT, DLQ):
+    (reference nats.go:369-381: submit/result/dlq/job.*/worker.*.jobs;
+    TRACE_SPAN added so a bus blip cannot silently hole a trace)."""
+    if subject in (SUBMIT, RESULT, DLQ, TRACE_SPAN):
         return True
     if subject.startswith(JOB_PREFIX):
         return True

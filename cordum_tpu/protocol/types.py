@@ -339,6 +339,35 @@ class SystemAlert(WireModel):
     labels: dict[str, str] = field(default_factory=dict)
 
 
+SPAN_OK = "OK"
+SPAN_ERROR = "ERROR"
+
+
+@dataclass
+class Span(WireModel):
+    """One timed segment of a trace (the flight-recorder unit).
+
+    Spans form a tree per ``trace_id`` via ``parent_span_id``; services
+    publish finished spans on the durable ``sys.trace.span`` subject and the
+    collector (``cordum_tpu/obs/collector.py``) persists them per trace.
+    Timestamps are wall-clock microseconds (``utils.ids.now_us`` — the job
+    store's clock) so spans from different processes line up."""
+
+    span_id: str = ""
+    parent_span_id: str = ""
+    trace_id: str = ""
+    name: str = ""  # stage name: submit/policy-check/schedule/dispatch/...
+    service: str = ""  # gateway/scheduler/safety-kernel/workflow-engine/worker
+    start_us: int = 0
+    end_us: int = 0
+    status: str = SPAN_OK
+    attrs: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def duration_us(self) -> int:
+        return max(0, self.end_us - self.start_us)
+
+
 # ---------------------------------------------------------------------------
 # safety kernel contract
 # ---------------------------------------------------------------------------
@@ -410,12 +439,18 @@ _PAYLOAD_TYPES: dict[str, type] = {
     "job_progress": JobProgress,
     "job_cancel": JobCancel,
     "system_alert": SystemAlert,
+    "span": Span,
 }
 
 
 @dataclass
 class BusPacket(WireModel):
-    """Envelope for every bus message (reference BusPacket oneof payload)."""
+    """Envelope for every bus message (reference BusPacket oneof payload).
+
+    ``span_id``/``parent_span_id`` carry flight-recorder span context across
+    process boundaries: a receiver that starts a span for the work this
+    packet triggers uses ``span_id`` as its parent (see docs/PROTOCOL.md
+    "Span context")."""
 
     trace_id: str = ""
     sender_id: str = ""
@@ -423,9 +458,19 @@ class BusPacket(WireModel):
     protocol_version: int = PROTOCOL_VERSION
     kind: str = ""
     payload: Any = None
+    span_id: str = ""  # span under which this packet was published
+    parent_span_id: str = ""  # that span's parent (for single-hop rebuilds)
 
     @classmethod
-    def wrap(cls, payload: Any, *, trace_id: str = "", sender_id: str = "") -> "BusPacket":
+    def wrap(
+        cls,
+        payload: Any,
+        *,
+        trace_id: str = "",
+        sender_id: str = "",
+        span_id: str = "",
+        parent_span_id: str = "",
+    ) -> "BusPacket":
         kind = ""
         for k, t in _PAYLOAD_TYPES.items():
             if isinstance(payload, t):
@@ -439,6 +484,8 @@ class BusPacket(WireModel):
             created_at_us=now_us(),
             kind=kind,
             payload=payload,
+            span_id=span_id,
+            parent_span_id=parent_span_id,
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -449,6 +496,12 @@ class BusPacket(WireModel):
             "protocol_version": self.protocol_version,
             "kind": self.kind,
         }
+        # span context rides only when set (wire stays lean for untraced
+        # packets; old peers tolerate the extra keys either way)
+        if self.span_id:
+            d["span_id"] = self.span_id
+        if self.parent_span_id:
+            d["parent_span_id"] = self.parent_span_id
         if self.payload is not None:
             d["payload"] = _to_plain(self.payload)
         return d
@@ -468,6 +521,8 @@ class BusPacket(WireModel):
             protocol_version=d.get("protocol_version", PROTOCOL_VERSION),
             kind=kind,
             payload=payload,
+            span_id=d.get("span_id", ""),
+            parent_span_id=d.get("parent_span_id", ""),
         )
 
     # typed accessors ------------------------------------------------------
@@ -494,6 +549,10 @@ class BusPacket(WireModel):
     @property
     def system_alert(self) -> Optional[SystemAlert]:
         return self.payload if self.kind == "system_alert" else None
+
+    @property
+    def span(self) -> Optional[Span]:
+        return self.payload if self.kind == "span" else None
 
 
 # nested-field converters for WireModel.from_dict

@@ -30,6 +30,16 @@ class HandlerError(Exception):
     pass
 
 
+def _maybe_timer(timer, **attrs: str):
+    """``ctx.device_timer`` when the caller passed one, else a no-op CM —
+    TPUCompute stays usable outside a traced JobContext (bench, tests)."""
+    if timer is not None:
+        return timer("device", **attrs)
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
 async def echo_handler(ctx: JobContext) -> Any:
     """Return the job context payload (plus a marker, like the hello worker)."""
     return {"echo": ctx.payload, "worker": ctx.worker.worker_id}
@@ -63,12 +73,14 @@ class TPUCompute:
         self._seed = seed
 
     # -- matmul -----------------------------------------------------------
-    def matmul(self, b: int, n: int, k: int, m: int, iters: int = 1, dtype: str = "bfloat16"):
+    def matmul(self, b: int, n: int, k: int, m: int, iters: int = 1, dtype: str = "bfloat16",
+               timer=None):
         import jax
         import jax.numpy as jnp
 
         key = (b, n, k, m, iters, dtype)
         fn = self._matmul_cache.get(key)
+        compiled = fn is not None  # device span attr: compile vs cached split
         if fn is None:
             dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 
@@ -89,7 +101,8 @@ class TPUCompute:
         x = jax.random.normal(kx, (b, n, k), dt)
         y = jax.random.normal(ky, (k, m), dt)
         y_back = jax.random.normal(kb, (m, k), dt)
-        out = jax.block_until_ready(run(x, y, y_back))
+        with _maybe_timer(timer, op="matmul", compile_cached=str(compiled).lower()):
+            out = jax.block_until_ready(run(x, y, y_back))
         return {
             "shape": list(out.shape),
             "checksum": float(jnp.sum(out.astype(jnp.float32))),
@@ -114,10 +127,11 @@ class TPUCompute:
 
             self._llama_fwd = fwd
 
-    def infer(self, tokens: list[list[int]], max_len: Optional[int] = None):
+    def infer(self, tokens: list[list[int]], max_len: Optional[int] = None, timer=None):
         import jax.numpy as jnp
         import numpy as np
 
+        compiled = self._llama_params is not None
         self._ensure_llama()
         cfg = self.llama_cfg
         t = max(len(r) for r in tokens)
@@ -126,8 +140,9 @@ class TPUCompute:
         for i, row in enumerate(tokens):
             row = [min(x, cfg.vocab_size - 1) for x in row[:t]]
             batch[i, : len(row)] = row
-        logits = self._llama_fwd(self._llama_params, jnp.asarray(batch))
-        next_tokens = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1)).tolist()
+        with _maybe_timer(timer, op="infer", compile_cached=str(compiled).lower()):
+            logits = self._llama_fwd(self._llama_params, jnp.asarray(batch))
+            next_tokens = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1)).tolist()
         return {"next_tokens": next_tokens, "seq_len": t}
 
 
@@ -152,20 +167,28 @@ def make_tpu_handlers(compute: TPUCompute):
                     int(payload.get("m", 512)),
                     int(payload.get("iters", 1)),
                     str(payload.get("dtype", "bfloat16")),
+                    timer=ctx.device_timer,
                 )
             )
         if op == "embed":
             texts = payload.get("texts")
             if not isinstance(texts, list) or not all(isinstance(t, str) for t in texts):
                 raise HandlerError("embed op requires texts: list[str]")
-            vecs = await ctx.worker.run_in_executor(compute.embedder.embed, texts)
+
+            def _embed():
+                with ctx.device_timer("device", op="embed"):
+                    return compute.embedder.embed(texts)
+
+            vecs = await ctx.worker.run_in_executor(_embed)
             return {"embeddings": np.asarray(vecs).tolist(), "dim": int(vecs.shape[1])}
         if op == "infer":
             tokens = payload.get("tokens")
             if not isinstance(tokens, list):
                 raise HandlerError("infer op requires tokens: list[list[int]]")
             return await ctx.worker.run_in_executor(
-                functools.partial(compute.infer, tokens, payload.get("max_len"))
+                functools.partial(
+                    compute.infer, tokens, payload.get("max_len"), timer=ctx.device_timer
+                )
             )
         if op == "train":
             import asyncio

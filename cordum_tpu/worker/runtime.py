@@ -29,6 +29,7 @@ from typing import Any, Awaitable, Callable, Optional
 from ..infra import logging as logx
 from ..infra.bus import Bus
 from ..infra.memstore import MemoryStore
+from ..obs.tracer import Tracer
 from ..protocol import subjects as subj
 from ..protocol.types import (
     BusPacket,
@@ -38,7 +39,9 @@ from ..protocol.types import (
     JobRequest,
     JobResult,
     JobState,
+    Span,
 )
+from ..utils.ids import new_id
 
 HEARTBEAT_INTERVAL_S = 10.0
 
@@ -56,6 +59,9 @@ class JobContext:
     worker: "Worker"
     cancelled: asyncio.Event = field(default_factory=asyncio.Event)
     started_at: float = field(default_factory=time.monotonic)
+    # (name, start_us, end_us, attrs) tuples recorded by device_timer();
+    # emitted as child spans of the execute span after the handler returns
+    device_records: list = field(default_factory=list)
 
     def check_cancelled(self) -> None:
         if self.cancelled.is_set():
@@ -63,6 +69,25 @@ class JobContext:
 
     async def progress(self, percent: float, message: str = "") -> None:
         await self.worker.publish_progress(self.request.job_id, percent, message)
+
+    def device_timer(self, name: str = "device", **attrs: str):
+        """Sync context manager timing device work (the wall time around
+        ``block_until_ready``).  Safe from executor threads: it only appends
+        to a list; the event loop publishes the spans when the job ends."""
+        from ..utils.ids import now_us
+
+        class _Timer:
+            def __enter__(timer):  # noqa: N805 - inner helper
+                timer.t0 = now_us()
+                return timer
+
+            def __exit__(timer, et, ev, tb) -> None:  # noqa: N805
+                rec_attrs = dict(attrs)
+                if et is not None:
+                    rec_attrs["error"] = et.__name__
+                self.device_records.append((name, timer.t0, now_us(), rec_attrs))
+
+        return _Timer()
 
 
 # Handlers may be ``async def`` (must not block the loop — use
@@ -109,6 +134,7 @@ class Worker:
         self._subs: list = []
         self._hb_task: Optional[asyncio.Task] = None
         self._executor = ThreadPoolExecutor(max_workers=max_parallel_jobs, thread_name_prefix=f"{worker_id}-jax")
+        self.tracer = Tracer("worker", bus)
         self._telemetry = _device_telemetry()
         self._busy_since: Optional[float] = None
         self._busy_accum = 0.0
@@ -163,9 +189,11 @@ class Worker:
         if req is None or not req.job_id:
             return
         async with self._sem:
-            await self._run_job(req, trace_id=pkt.trace_id)
+            await self._run_job(req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id)
 
-    async def _run_job(self, req: JobRequest, *, trace_id: str = "") -> None:
+    async def _run_job(
+        self, req: JobRequest, *, trace_id: str = "", parent_span_id: str = ""
+    ) -> None:
         if req.job_id in self._active:
             return  # redelivery of an in-flight job
         cached = self._completed.get(req.job_id)
@@ -185,6 +213,14 @@ class Worker:
         ctx = JobContext(request=req, payload=payload, worker=self)
         self._active[req.job_id] = ctx
         self._mark_busy()
+        # execute span: the worker-side leg of the trace (parent = the
+        # scheduler's dispatch span carried on the job packet)
+        exec_span = self.tracer.begin(
+            "execute",
+            trace_id=trace_id,
+            parent_span_id=parent_span_id,
+            attrs={"job_id": req.job_id, "topic": req.topic, "worker_id": self.worker_id},
+        )
         t0 = time.monotonic()
         status = JobState.SUCCEEDED.value
         error_code = error_message = ""
@@ -218,6 +254,26 @@ class Worker:
         finally:
             self._active.pop(req.job_id, None)
             self._mark_idle()
+        exec_span.attrs["status"] = status
+        if error_code:
+            exec_span.attrs["error_code"] = error_code
+        await self.tracer.finish(
+            exec_span,
+            status="OK" if status == JobState.SUCCEEDED.value else "ERROR",
+        )
+        # device-time spans recorded by handlers (wall time around
+        # block_until_ready, compile/host split in attrs when known)
+        for name, start_us, end_us, attrs in ctx.device_records:
+            await self.tracer.emit(Span(
+                span_id=new_id(),
+                parent_span_id=exec_span.span_id,
+                trace_id=trace_id,
+                name=name,
+                service="worker",
+                start_us=start_us,
+                end_us=end_us,
+                attrs={"job_id": req.job_id, **attrs},
+            ))
         res = JobResult(
             job_id=req.job_id,
             status=status,
@@ -231,7 +287,13 @@ class Worker:
         if len(self._completed) > self._completed_cap:
             for k in list(itertools.islice(self._completed, self._completed_cap // 2)):
                 del self._completed[k]
-        await self.bus.publish(subj.RESULT, BusPacket.wrap(res, trace_id=trace_id, sender_id=self.worker_id))
+        await self.bus.publish(
+            subj.RESULT,
+            BusPacket.wrap(
+                res, trace_id=trace_id, sender_id=self.worker_id,
+                span_id=exec_span.span_id, parent_span_id=exec_span.parent_span_id,
+            ),
+        )
 
     # ------------------------------------------------------------------
     async def publish_progress(self, job_id: str, percent: float, message: str = "") -> None:

@@ -30,6 +30,7 @@ from ..infra.configsvc import ConfigService
 from ..infra.memstore import MemoryStore
 from ..infra.metrics import Metrics
 from ..infra.schemareg import SchemaRegistry
+from ..obs.tracer import Tracer
 from ..protocol import subjects as subj
 from ..protocol.types import (
     BusPacket,
@@ -98,6 +99,7 @@ class Engine:
         self.configsvc = configsvc
         self.metrics = metrics or Metrics()
         self.instance_id = instance_id
+        self.tracer = Tracer("workflow-engine", bus)
 
     # ------------------------------------------------------------------
     # run lifecycle
@@ -338,8 +340,22 @@ class Engine:
                 await self._timeline(run, key, "step_failed", sr.error)
                 return
         req = await self._build_job_request(run, step, job_id, payload, index)
-        await self.mem.put_context(job_id, payload)
-        await self.bus.publish(subj.SUBMIT, BusPacket.wrap(req, sender_id=self.instance_id))
+        # each step dispatch opens a fresh trace rooted at this span; the
+        # scheduler/worker legs attach below it via the packet's span context
+        trace_id = new_id()
+        async with self.tracer.span(
+            "step-dispatch",
+            trace_id=trace_id,
+            attrs={"run_id": run.run_id, "step": key, "job_id": job_id},
+        ) as sp:
+            await self.mem.put_context(job_id, payload)
+            await self.bus.publish(
+                subj.SUBMIT,
+                BusPacket.wrap(
+                    req, trace_id=trace_id, sender_id=self.instance_id,
+                    span_id=sp.span_id,
+                ),
+            )
         self.metrics.workflow_steps.inc(topic=step.topic)
         await self._timeline(run, key, "step_dispatched", job_id)
 

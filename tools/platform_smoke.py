@@ -10,7 +10,9 @@ TPU worker as separate OS processes, then over plain HTTP:
   2. install demo-guardrails pack (admin)
   3. destructive job → DENIED (+ DLQ entry + remediation available)
   4. full-slice (chips:8) job → APPROVAL_REQUIRED → approve → dispatched
-  5. approval-only workflow → approve step → run succeeded
+  5. flight recorder: traced job → span waterfall (≥5 spans, ≥4 services),
+     cordum_stage_seconds in /metrics, `cordum trace` CLI render
+  6. approval-only workflow → approve step → run succeeded
 
 Exit 0 = PASS.  Usage: python tools/platform_smoke.py [--keep]
 """
@@ -239,7 +241,42 @@ def main() -> int:
             log("4. full-slice job approved and executed "
                 f"(worker={doc.get('worker_id')})")
 
-            # 5. approval workflow (guarded-inference from the pack)
+            # 5. flight recorder: an end-to-end job yields a queryable span
+            # waterfall across >=4 services, stage histograms hit /metrics,
+            # and the CLI renders it
+            r = c.post("/api/v1/jobs", json={
+                "topic": "job.default", "payload": {"op": "echo", "message": "traced"}})
+            jid, trace_id = r.json()["job_id"], r.json()["trace_id"]
+            wait_job(c, jid, "SUCCEEDED")
+            trace = {}
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                trace = c.get(f"/api/v1/traces/{trace_id}").json()
+                if trace.get("span_count", 0) >= 5 and len(trace.get("services") or []) >= 4:
+                    break
+                time.sleep(0.5)
+            assert trace.get("span_count", 0) >= 5, trace
+            services = set(trace.get("services") or [])
+            assert {"gateway", "scheduler", "safety-kernel", "worker"} <= services, services
+            assert trace.get("critical_path"), trace
+            metrics_text = httpx.get(f"{API}/metrics", timeout=10.0).text
+            stage_counts = [
+                ln for ln in metrics_text.splitlines()
+                if ln.startswith("cordum_stage_seconds_count") and not ln.rstrip().endswith(" 0")
+            ]
+            assert stage_counts, "no non-zero cordum_stage_seconds in /metrics"
+            cli = subprocess.run(
+                [sys.executable, "-m", "cordum_tpu.cli", "trace", trace_id],
+                capture_output=True, text=True, timeout=30, cwd=REPO,
+                env={**os.environ, "CORDUM_API_URL": API,
+                     "CORDUM_API_KEY": H_USER["X-Api-Key"],
+                     "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")},
+            )
+            assert cli.returncode == 0 and f"trace {trace_id}" in cli.stdout, cli.stderr
+            log(f"5. trace {trace_id[:8]} has {trace['span_count']} spans over "
+                f"{len(services)} services; stage histograms live; CLI waterfall OK")
+
+            # 6. approval workflow (guarded-inference from the pack)
             r = c.post("/api/v1/workflows/guarded-inference/runs",
                        json={"input": {"tokens": [[1, 2, 3]]}})
             run_id = r.json()["run_id"]
@@ -253,7 +290,7 @@ def main() -> int:
             r = admin.post(f"/api/v1/runs/{run_id}/steps/gate/approve", json={"approve": True})
             assert r.status_code == 200, r.text
             wait_run(c, run_id, "SUCCEEDED")
-            log("5. guarded-inference run approved → SUCCEEDED")
+            log("6. guarded-inference run approved → SUCCEEDED")
 
         log("PASS")
         return 0
