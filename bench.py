@@ -15,8 +15,15 @@ Four benches, one JSON line:
   ``model_tokens_per_sec``+``mfu`` (Llama forward).  These run in a
   SUBPROCESS with a hard watchdog: a wedged TPU grant or a crashed PJRT
   client must never take down the control-plane numbers, and any failure
-  is reported in ``embed_error``/``model_error`` — never swallowed.
+  is reported in ``embed_error``/``model_error`` — never swallowed.  A host
+  with no TPU skips cleanly (``{"skipped": "no tpu"}``) and the cpu
+  fallback carries the run without fabricating errors.
+* Micro-batching: ``batched_embeds_per_sec`` vs ``single_job_embeds_per_sec``
+  through the REAL worker path (bus → context fetch → batch queue →
+  bucketed XLA flush → result publish); the acceptance bar is ≥3× the
+  single-job rate on the same host.
 
+``--smoke`` runs a fast CI-sized pass (small job counts, cpu-only child).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 from __future__ import annotations
@@ -262,13 +269,26 @@ def _jax_child(device: str) -> None:
     if device == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
     out: dict = {}
-    import jax
+    try:
+        import jax
 
-    if device == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-
-    dev = jax.devices()[0]
+        if device == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+    except Exception as ex:  # noqa: BLE001 - "no TPU" is an expected outcome
+        if device == "tpu":
+            # no TPU on this host is not a failure: exit cleanly so the
+            # driver falls back to the cpu child without an embed_error
+            print(json.dumps({"skipped": "no tpu",
+                              "detail": f"{type(ex).__name__}: {ex}"[:300]}),
+                  flush=True)
+            return
+        raise
+    dev = devs[0]
+    if device == "tpu" and dev.platform != "tpu":
+        print(json.dumps({"skipped": "no tpu",
+                          "detail": f"jax backend is {dev.platform!r}"}), flush=True)
+        return
     out["device"] = dev.device_kind
     peak = 0.0
     for gen, flops in PEAK_FLOPS.items():
@@ -337,19 +357,128 @@ def _jax_child(device: str) -> None:
     except Exception as ex:  # noqa: BLE001
         out["model_error"] = f"{type(ex).__name__}: {ex}"[:300]
 
+    # --- micro-batching: the REAL worker path, single-job vs batched ---
+    # (ISSUE 3 acceptance: batched_embeds_per_sec >= 3x the single-job path)
+    try:
+        out.update(asyncio.run(_bench_worker_embeds(device)))
+    except Exception as ex:  # noqa: BLE001
+        out["batched_error"] = f"{type(ex).__name__}: {ex}"[:300]
+
     print(json.dumps(out), flush=True)
 
 
-def bench_jax() -> dict:
+async def _bench_worker_embeds(device: str) -> dict:
+    """Drive 1-text embed jobs through a real Worker twice — micro-batcher
+    off (one XLA dispatch per job) then on (bucketed coalesced calls) — and
+    report both rates.  This is the end-to-end worker path: bus delivery,
+    context-pointer fetch, batch queueing, executor dispatch, result publish.
+    """
+    from cordum_tpu.infra.bus import LoopbackBus
+    from cordum_tpu.infra.kv import MemoryKV
+    from cordum_tpu.infra.memstore import MemoryStore
+    from cordum_tpu.models.embedder import EmbedderConfig
+    from cordum_tpu.protocol import subjects as subj
+    from cordum_tpu.protocol.types import BusPacket, JobRequest
+    from cordum_tpu.worker.handlers import (
+        TPUCompute, make_micro_batcher, make_tpu_handlers,
+    )
+    from cordum_tpu.worker.runtime import Worker
+
+    if device == "cpu":
+        cfg = EmbedderConfig(n_layers=2, d_model=128, max_len=64)
+        n_jobs = 96
+    else:
+        cfg = EmbedderConfig()
+        n_jobs = 512
+    text = "control plane scheduling latency report for document"
+
+    async def run_pass(batched: bool) -> dict:
+        kv = MemoryKV()
+        bus = LoopbackBus()
+        ms = MemoryStore(kv)
+        worker = Worker(bus=bus, store=ms, worker_id="bench-w",
+                        pool="bench", heartbeat_interval_s=999)
+        compute = TPUCompute(tp=1, embedder_cfg=cfg)
+        worker.register_default(make_tpu_handlers(compute))
+        if batched:
+            worker.attach_batcher(make_micro_batcher(
+                compute, worker, max_batch_rows=32, max_wait_ms=5.0))
+        await worker.start()
+        # warm the XLA programs both paths will hit so the timed loop
+        # measures dispatch, not compilation
+        compute.embedder.embed([text])
+        compute.embed_batch([text] * 32, seq_len=16)
+        compute.embed_batch([text], seq_len=16)
+
+        done = asyncio.Event()
+        seen = set()
+
+        async def tap(subject, pkt):
+            res = pkt.job_result
+            if res is not None and res.status == "SUCCEEDED":
+                seen.add(res.job_id)
+                if len(seen) >= n_jobs:
+                    done.set()
+
+        sub = await bus.subscribe(subj.RESULT, tap)
+        prefix = "b" if batched else "s"
+        ptrs = []
+        for i in range(n_jobs):
+            jid = f"{prefix}{i}"
+            ptrs.append((jid, await ms.put_context(jid, {"op": "embed", "texts": [text]})))
+        t0 = time.perf_counter()
+        for jid, ptr in ptrs:
+            await bus.publish(
+                subj.direct_subject("bench-w"),
+                BusPacket.wrap(JobRequest(job_id=jid, topic="job.tpu.embed",
+                                          context_ptr=ptr)),
+            )
+        await asyncio.wait_for(done.wait(), timeout=JAX_TIMEOUT_S / 2)
+        dt = time.perf_counter() - t0
+        stats = worker.batcher.stats if worker.batcher else None
+        sub.unsubscribe()
+        await worker.stop()
+        await bus.close()
+        return {
+            "embeds_per_sec": n_jobs / dt if dt > 0 else 0.0,
+            "flushes": stats.flushes if stats else 0,
+            "max_batch": stats.max_batch_rows_seen if stats else 0,
+        }
+
+    single = await run_pass(False)
+    batched = await run_pass(True)
+    return {
+        "single_job_embeds_per_sec": round(single["embeds_per_sec"], 1),
+        "batched_embeds_per_sec": round(batched["embeds_per_sec"], 1),
+        "batched_speedup": round(
+            batched["embeds_per_sec"] / single["embeds_per_sec"], 2
+        ) if single["embeds_per_sec"] else 0.0,
+        "batch_flushes": batched["flushes"],
+        "max_batch_rows": batched["max_batch"],
+    }
+
+
+_CHILD_METRIC_KEYS = (
+    "embeds_per_sec", "model_tokens_per_sec", "model_achieved_tflops",
+    "model_params_m", "single_job_embeds_per_sec", "batched_embeds_per_sec",
+    "batched_speedup", "batch_flushes", "max_batch_rows",
+)
+
+
+def bench_jax(*, smoke: bool = False) -> dict:
     """Run the TPU bench child; fall back to a CPU child so the compute path
     is still exercised when the TPU is unavailable (clearly labeled).
 
-    Child failures are NEVER silently degraded into a partial metric: the
-    full child traceback rides along in ``child_traceback`` and main() flags
-    the run ``degraded`` with a loud stderr warning (CL002 applied to the
-    bench harness)."""
+    A host without a TPU is NOT a failure: the tpu child exits cleanly with
+    ``{"skipped": "no tpu"}`` and the cpu fallback's success clears any
+    tpu-pass error (it survives as ``tpu_*_error`` context).  Real child
+    failures are never silently degraded into a partial metric: the full
+    child traceback rides along in ``child_traceback`` and main() flags the
+    run ``degraded`` with a loud stderr warning (CL002 applied to the bench
+    harness)."""
     results: dict = {}
-    for device in ("tpu", "cpu"):
+    devices = ("cpu",) if smoke else ("tpu", "cpu")
+    for device in devices:
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--jax-child", device],
@@ -375,8 +504,13 @@ def bench_jax() -> dict:
         except Exception as ex:  # noqa: BLE001
             child = {"embed_error": f"{type(ex).__name__}: {ex}"[:300]}
         if device == "tpu":
+            if child.get("skipped"):
+                # no TPU on this host: clean skip, cpu pass carries the run
+                results["tpu_skipped"] = str(child.get("detail") or child["skipped"])
+                continue
             results = dict(child)
-            if "embeds_per_sec" in child and "model_tokens_per_sec" in child:
+            if all(k in child for k in
+                   ("embeds_per_sec", "model_tokens_per_sec", "batched_embeds_per_sec")):
                 return results
             # remember why the TPU pass failed, then try CPU for coverage;
             # only backfill embed_error if the embed bench itself is missing
@@ -385,24 +519,41 @@ def bench_jax() -> dict:
                 results["embed_error"] = results.get("model_error", "unknown")
         else:
             # merge CPU numbers for whichever metric the TPU pass missed
-            for k in ("embeds_per_sec", "model_tokens_per_sec",
-                      "model_achieved_tflops", "model_params_m"):
+            for k in _CHILD_METRIC_KEYS:
                 if k not in results and k in child:
                     results[k] = child[k]
                     results["fallback_device"] = child.get("device", "cpu")
-            if "child_traceback" not in results and "child_traceback" in child:
-                results["child_traceback"] = child["child_traceback"]
+            for k in ("embed_error", "model_error", "batched_error", "child_traceback"):
+                if k not in results and k in child:
+                    results[k] = child[k]
+            if "device" not in results and "device" in child:
+                results["device"] = child["device"]
+    # the cpu fallback succeeded for a metric → the tpu-pass error is
+    # context, not a failure (the noisy BENCH_r05 embed_error fix)
+    for metric, err in (("embeds_per_sec", "embed_error"),
+                        ("model_tokens_per_sec", "model_error"),
+                        ("batched_embeds_per_sec", "batched_error")):
+        if metric in results and err in results and results.get("fallback_device"):
+            results[f"tpu_{err}"] = results.pop(err)
     return results
 
 
 def main() -> None:
+    global N_JOBS, PACED_JOBS, PACED_RATE, JAX_TIMEOUT_S
     if len(sys.argv) >= 2 and sys.argv[1] == "--jax-child":
         _jax_child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
         return
+    smoke = "--smoke" in sys.argv
+    if smoke:
+        # CI sanity mode: small sizes, cpu-only compute child, same JSON shape
+        N_JOBS = min(N_JOBS, 400)
+        PACED_JOBS = min(PACED_JOBS, 200)
+        PACED_RATE = min(PACED_RATE, 500.0)
+        JAX_TIMEOUT_S = min(JAX_TIMEOUT_S, 240.0)
     sched = asyncio.run(bench_scheduler())
     lat = asyncio.run(bench_latency())
     sel = bench_selection()
-    jx = bench_jax()
+    jx = bench_jax(smoke=smoke)
     out = {
         "metric": "scheduled_jobs_per_sec",
         "value": round(sched["jobs_per_sec"], 1),
@@ -424,10 +575,20 @@ def main() -> None:
         "mfu": jx.get("mfu", None),
         "model_achieved_tflops": round(jx.get("model_achieved_tflops", 0.0), 2),
         "embed_device": jx.get("device", ""),
+        # micro-batching: the real worker path, per-job vs coalesced
+        "single_job_embeds_per_sec": jx.get("single_job_embeds_per_sec", 0.0),
+        "batched_embeds_per_sec": jx.get("batched_embeds_per_sec", 0.0),
+        "batched_speedup": jx.get("batched_speedup", 0.0),
+        "batch_flushes": jx.get("batch_flushes", 0),
+        "batched_error": jx.get("batched_error", ""),
     }
-    if "fallback_device" in jx:
-        out["fallback_device"] = jx["fallback_device"]
-    degraded = bool(out["embed_error"] or out["model_error"])
+    if smoke:
+        out["smoke"] = True
+    for k in ("fallback_device", "tpu_skipped", "tpu_embed_error",
+              "tpu_model_error", "tpu_batched_error"):
+        if k in jx:
+            out[k] = jx[k]
+    degraded = bool(out["embed_error"] or out["model_error"] or out["batched_error"])
     out["degraded"] = degraded
     if degraded:
         out["child_traceback"] = jx.get("child_traceback", "")
@@ -437,6 +598,7 @@ def main() -> None:
             "partial or missing. Child errors:\n"
             f"    embed_error: {out['embed_error'] or '-'}\n"
             f"    model_error: {out['model_error'] or '-'}\n"
+            f"    batched_error: {out['batched_error'] or '-'}\n"
         )
         if out["child_traceback"]:
             sys.stderr.write("--- child traceback (tail) ---\n")

@@ -1,0 +1,21 @@
+"""Micro-batching engine: coalesce batchable TPU jobs into bucketed XLA calls.
+
+One control-plane job per XLA dispatch leaves the chip idle between tiny
+programs — the device round-trip dominates for single-text ``embed`` and
+short ``infer`` requests.  This package sits between the worker's job intake
+and the XLA handlers: batchable jobs land in per-(op, length-bucket) queues,
+an adaptive window flushes each queue into ONE padded bf16 XLA call, and the
+per-job results scatter back so downstream consumers see ordinary
+``JobResult`` packets (see ``docs/BATCHING.md``).
+"""
+from .buckets import bucket_for, pow2_buckets
+from .engine import BatchCancelled, BatchItem, BatchParts, MicroBatcher
+
+__all__ = [
+    "BatchCancelled",
+    "BatchItem",
+    "BatchParts",
+    "MicroBatcher",
+    "bucket_for",
+    "pow2_buckets",
+]

@@ -3,6 +3,11 @@ as JAX computations (the north star's ``sdk/runtime`` TPU worker).
 
 Env: WORKER_ID, WORKER_POOL, WORKER_TOPICS (comma), WORKER_CAPABILITIES,
 WORKER_MAX_PARALLEL, WORKER_TP (tensor-parallel width for the local mesh).
+
+Micro-batching (cordum_tpu/batching) is on by default; limits come from the
+worker's pool stanza in pools.yaml (``max_batch_size`` /
+``max_batch_wait_ms``), overridable via WORKER_MAX_BATCH_SIZE /
+WORKER_BATCH_WAIT_MS, and WORKER_BATCHING=0 disables it.
 """
 from __future__ import annotations
 
@@ -24,22 +29,44 @@ from ..worker.runtime import Worker
 from . import _boot
 
 
+def _pool_batch_limits(cfg, pool_name: str) -> tuple[int, float]:
+    """Batch limits for this worker's pool from pools.yaml (0/0.0 = defaults).
+    A missing or invalid pool file must not stop a worker from booting."""
+    try:
+        from ..infra.config import load_pool_config
+
+        pool = load_pool_config(cfg.pool_config_path).pools.get(pool_name)
+    except Exception:  # noqa: BLE001 - batching config is best-effort
+        pool = None
+    if pool is None:
+        return 0, 0.0
+    return pool.max_batch_size, pool.max_batch_wait_ms
+
+
 async def main() -> None:
     cfg = _boot.setup()
     kv, bus, conn = await _boot.connect_statebus(cfg)
     env = os.environ
+    pool_name = env.get("WORKER_POOL", "tpu-default")
     worker = Worker(
         bus=bus,
         store=MemoryStore(kv),
         worker_id=env.get("WORKER_ID", f"tpu-worker-{os.getpid()}"),
-        pool=env.get("WORKER_POOL", "tpu-default"),
+        pool=pool_name,
         topics=[t for t in env.get("WORKER_TOPICS", "job.tpu.>").split(",") if t],
         capabilities=[c for c in env.get("WORKER_CAPABILITIES", "tpu,echo").split(",") if c],
         max_parallel_jobs=_boot.env_int("WORKER_MAX_PARALLEL", 4),
         heartbeat_interval_s=_boot.env_float("WORKER_HEARTBEAT_INTERVAL", 10.0),
         region=env.get("WORKER_REGION", ""),
     )
-    attach_default_tpu_worker(worker, tp=_boot.env_int("WORKER_TP", 1))
+    pool_rows, pool_wait = _pool_batch_limits(cfg, pool_name)
+    attach_default_tpu_worker(
+        worker,
+        tp=_boot.env_int("WORKER_TP", 1),
+        batching=env.get("WORKER_BATCHING", "1") != "0",
+        max_batch_rows=_boot.env_int("WORKER_MAX_BATCH_SIZE", 0) or pool_rows or 32,
+        max_batch_wait_ms=_boot.env_float("WORKER_BATCH_WAIT_MS", 0.0) or pool_wait or 25.0,
+    )
     await worker.start()
     try:
         await _boot.wait_for_shutdown()

@@ -72,11 +72,96 @@ class EmbedFn:
         raise NotImplementedError
 
 
+class PoolEmbedder(EmbedFn):
+    """EmbedFn that runs on the TPU worker pool instead of in-process.
+
+    Texts are split into per-job slices and submitted through the gateway's
+    bulk endpoint (``POST /api/v1/jobs:batch``) in ONE HTTP round trip; the
+    scheduler's batch affinity routes the slices to one worker, whose
+    micro-batcher fuses them into a single padded XLA call
+    (docs/BATCHING.md).  Synchronous (httpx.Client) — meant for re-indexing
+    tools and benches, not for calling inside an event loop."""
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        api_key: str = "",
+        topic: str = "job.tpu.embed",
+        texts_per_job: int = 16,
+        timeout_s: float = 120.0,
+        poll_s: float = 0.05,
+    ) -> None:
+        import httpx
+
+        headers = {"X-Api-Key": api_key} if api_key else {}
+        self._c = httpx.Client(base_url=base_url, headers=headers, timeout=timeout_s)
+        self.topic = topic
+        self.texts_per_job = max(1, texts_per_job)
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+
+    def close(self) -> None:
+        self._c.close()
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        import time
+
+        jobs = [
+            {"topic": self.topic,
+             "payload": {"op": "embed", "texts": list(texts[i:i + self.texts_per_job])}}
+            for i in range(0, len(texts), self.texts_per_job)
+        ]
+        r = self._c.post("/api/v1/jobs:batch", json={"jobs": jobs})
+        r.raise_for_status()
+        docs = r.json()["jobs"]
+        parts: list[np.ndarray] = []
+        deadline = time.monotonic() + self.timeout_s
+        for doc in docs:
+            jid = doc.get("job_id")
+            if not jid:
+                raise RuntimeError(f"bulk submit rejected a slice: {doc}")
+            while True:
+                s = self._c.get(f"/api/v1/jobs/{jid}?result=true").json()
+                state = s.get("state")
+                if state == "SUCCEEDED":
+                    parts.append(np.asarray(s["result"]["embeddings"], np.float32))
+                    break
+                if state in ("FAILED", "DENIED", "CANCELLED", "TIMEOUT"):
+                    raise RuntimeError(f"embed job {jid} reached {state}: "
+                                       f"{s.get('error_message', '')}")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"embed job {jid} not terminal "
+                                       f"after {self.timeout_s}s")
+                time.sleep(self.poll_s)
+        return np.concatenate(parts, axis=0)
+
+
 class ContextService:
-    def __init__(self, kv: KV, *, embedder: Optional[Any] = None, max_chunks: int = 10):
+    def __init__(
+        self,
+        kv: KV,
+        *,
+        embedder: Optional[Any] = None,
+        max_chunks: int = 10,
+        embed_batch: int = 64,
+    ):
         self.kv = kv
         self.embedder = embedder
         self.max_chunks = max_chunks
+        # re-index embedding slice size (the `context.embed_batch` effective-
+        # config field): bounds one embed call / one pool job per slice
+        self.embed_batch = max(1, embed_batch)
+
+    def _embed_texts(self, texts: list[str]) -> np.ndarray:
+        """Embed through the bulk path in ``embed_batch``-sized slices so a
+        large re-index becomes a few padded batch calls (local embedder) or
+        a few pool jobs (PoolEmbedder) instead of one unbounded call."""
+        parts = [
+            np.asarray(self.embedder.embed(texts[i:i + self.embed_batch]))
+            for i in range(0, len(texts), self.embed_batch)
+        ]
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
 
     # ------------------------------------------------------------------
     async def update_memory(
@@ -111,7 +196,7 @@ class ContextService:
             if await self.kv.get(_embed_key(memory_id, h)) is None:
                 missing.append((h, _chunk_text(c)))
         if missing:
-            vecs = self.embedder.embed([t for _, t in missing])
+            vecs = self._embed_texts([t for _, t in missing])
             for (h, _), v in zip(missing, np.asarray(vecs)):
                 await self.kv.set(
                     _embed_key(memory_id, h), np.asarray(v, np.float32).tobytes()
@@ -180,7 +265,7 @@ class ContextService:
                 else:
                     to_embed.append((i, _chunk_text(c)))
             if to_embed:
-                new_vecs = np.asarray(self.embedder.embed([t for _, t in to_embed]))
+                new_vecs = np.asarray(self._embed_texts([t for _, t in to_embed]))
                 for (i, _), v in zip(to_embed, new_vecs):
                     vecs[i] = np.asarray(v, np.float32)
                     await self.kv.set(

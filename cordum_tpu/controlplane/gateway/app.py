@@ -58,10 +58,12 @@ from ...protocol.types import (
     JobRequest,
     JobState,
     LABEL_APPROVAL_GRANTED,
+    LABEL_BATCH_KEY,
     LABEL_BUS_MSG_ID,
     LABEL_SECRETS_PRESENT,
     PolicyCheckRequest,
     TERMINAL_STATES,
+    payload_batch_key,
 )
 from ...utils.ids import new_id, now_us
 from ...workflow.engine import Engine as WorkflowEngine, WorkflowError
@@ -71,6 +73,7 @@ from ..safetykernel.kernel import SafetyKernel
 from .auth import AuthProvider, BasicAuthProvider, Principal, TokenBucket
 
 MAX_BODY_BYTES = 2 * 1024 * 1024  # 2 MiB submit cap (reference gateway.go:1757)
+MAX_BULK_JOBS = 256  # jobs per POST /api/v1/jobs:batch
 
 
 def _err(status: int, message: str) -> web.Response:
@@ -135,6 +138,9 @@ class Gateway:
         r = app.router
         v1 = "/api/v1"
         r.add_post(f"{v1}/jobs", self.submit_job)
+        # bulk submit: many jobs, one HTTP round trip (micro-batching's
+        # client-side leg — amortizes per-job HTTP+bus overhead)
+        r.add_post(f"{v1}/jobs:batch", self.submit_jobs_bulk)
         r.add_get(f"{v1}/jobs", self.list_jobs)
         r.add_get(f"{v1}/jobs/{{job_id}}", self.get_job)
         r.add_post(f"{v1}/jobs/{{job_id}}/cancel", self.cancel_job)
@@ -337,25 +343,73 @@ class Gateway:
             body = await request.json()
         except Exception:
             return _err(400, "invalid JSON body")
+        status, doc = await self._submit_one(
+            body, principal,
+            idempotency_header=request.headers.get("Idempotency-Key", ""),
+        )
+        return web.json_response(doc, status=status)
+
+    async def submit_jobs_bulk(self, request: web.Request) -> web.Response:
+        """``POST /api/v1/jobs:batch`` — submit many jobs in one round trip
+        (body ``{"jobs": [<single-submit bodies>]}``).  Per-job verdicts ride
+        back positionally; one bad job does not reject its batch-mates."""
+        principal: Principal = request["principal"]
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        jobs = body.get("jobs")
+        if not isinstance(jobs, list) or not jobs:
+            return _err(400, "jobs: non-empty list required")
+        if len(jobs) > MAX_BULK_JOBS:
+            return _err(400, f"too many jobs in one batch (max {MAX_BULK_JOBS})")
+        out: list[dict[str, Any]] = []
+        accepted = 0
+        for doc in jobs:
+            if not isinstance(doc, dict):
+                out.append({"error": "job body must be an object", "status": 400})
+                continue
+            status, res = await self._submit_one(doc, principal)
+            if status >= 400:
+                out.append({"error": str(res.get("error", "rejected")), "status": status})
+            else:
+                accepted += 1
+                out.append(res)
+        return web.json_response(
+            {"jobs": out, "accepted": accepted, "rejected": len(out) - accepted},
+            status=202 if accepted else 400,
+        )
+
+    async def _submit_one(
+        self, body: dict, principal: Principal, *, idempotency_header: str = ""
+    ) -> tuple[int, dict]:
+        """The submit core shared by the single and bulk routes: validate,
+        stamp labels (secrets, batch key), persist, publish.  Returns
+        (http_status, response_doc)."""
         topic = str(body.get("topic", ""))
         if not topic:
-            return _err(400, "topic is required")
+            return 400, {"error": "topic is required"}
         payload = body.get("payload", body.get("context"))
         tenant = str(body.get("tenant_id") or principal.tenant_id)
         if tenant != principal.tenant_id and not principal.key_admin:
             # body tenant_id may not escape the key's tenant scope; gate on
             # key-derived admin status, not the forgeable role header
             # (reference RequireTenantAccess, basic_auth.go:100-122)
-            return _err(403, f"tenant {tenant!r} not permitted for this principal")
+            return 403, {"error": f"tenant {tenant!r} not permitted for this principal"}
         job_id = str(body.get("job_id") or new_id())
 
-        idem = str(body.get("idempotency_key") or request.headers.get("Idempotency-Key", ""))
+        idem = str(body.get("idempotency_key") or idempotency_header)
         if idem:
             fresh, existing = await self.job_store.try_set_idempotency_key(tenant, idem, job_id)
             if not fresh:
-                return web.json_response({"job_id": existing, "deduplicated": True})
+                return 200, {"job_id": existing, "deduplicated": True}
 
         labels = {str(k): str(v) for k, v in (body.get("labels") or {}).items()}
+        # batchable payloads carry their batch key as a label so the
+        # scheduler can batch-affinity-route without reading the payload
+        bkey = payload_batch_key(payload)
+        if bkey and LABEL_BATCH_KEY not in labels:
+            labels[LABEL_BATCH_KEY] = bkey
         meta_doc = body.get("metadata") or {}
         metadata = JobMetadata(
             capability=str(meta_doc.get("capability", "")),
@@ -416,10 +470,7 @@ class Gateway:
                     span_id=sp.span_id,
                 ),
             )
-        return web.json_response(
-            {"job_id": job_id, "trace_id": trace_id, "state": JobState.PENDING.value},
-            status=202,
-        )
+        return 202, {"job_id": job_id, "trace_id": trace_id, "state": JobState.PENDING.value}
 
     async def get_job(self, request: web.Request) -> web.Response:
         job_id = request.match_info["job_id"]

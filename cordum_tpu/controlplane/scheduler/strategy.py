@@ -15,6 +15,10 @@ TPU-slice awareness:
     cycle ≥90, or unhealthy devices
   * score = ``active_jobs + cpu_load/100 + tpu_duty_cycle/100`` (reference
     used gpu_utilization; TPU duty cycle is the analogue); least wins
+  * batch affinity: jobs carrying the ``cordum.batch_key`` label stick to
+    the worker that last won for that key (TTL'd), so the worker-side
+    micro-batch queues actually fill instead of each job landing on a
+    different slice (docs/BATCHING.md)
   * chosen worker → direct subject ``worker.<id>.jobs``; no worker →
     topic fan-in subject (queue-group consumption)
 
@@ -22,19 +26,23 @@ TPU-slice awareness:
 """
 from __future__ import annotations
 
+import itertools
 import re
+import time
 from typing import Optional
 
 from ...infra.config import Pool, PoolConfig
 from ...infra.registry import WorkerRegistry
 from ...protocol.subjects import direct_subject
-from ...protocol.types import Heartbeat, JobRequest
+from ...protocol.types import Heartbeat, JobRequest, LABEL_BATCH_KEY
 
 _CHIPS_RE = re.compile(r"^chips:(\d+)$")
 _TOPOLOGY_RE = re.compile(r"^topology:([0-9x]+)$")
 
 OVERLOAD_FRACTION = 0.9
 OVERLOAD_UTIL = 90.0
+BATCH_AFFINITY_TTL_S = 5.0
+_AFFINITY_CAP = 1024
 
 
 class Strategy:
@@ -116,6 +124,8 @@ class LeastLoadedStrategy(Strategy):
     def __init__(self, registry: WorkerRegistry, pool_config: PoolConfig, *, native: bool = True):
         self.registry = registry
         self._pool_config = pool_config
+        # batch affinity: batch_key -> (worker_id, stamped_monotonic)
+        self._affinity: dict[str, tuple[str, float]] = {}
         self._packed = None
         if native:
             try:
@@ -129,6 +139,42 @@ class LeastLoadedStrategy(Strategy):
 
     def update_routing(self, pool_config: PoolConfig) -> None:
         self._pool_config = pool_config
+
+    # -- batch affinity ---------------------------------------------------
+    def _record_affinity(self, key: str, worker_id: str) -> None:
+        if len(self._affinity) >= _AFFINITY_CAP:
+            # amortized prune: drop the oldest half (insertion-ordered dict)
+            for k in list(itertools.islice(self._affinity, _AFFINITY_CAP // 2)):
+                del self._affinity[k]
+        self._affinity[key] = (worker_id, time.monotonic())
+
+    def _affinity_worker(
+        self, key: str, pools: list[Pool], job_requires: list[str],
+        placement: dict[str, str],
+    ) -> str:
+        """The sticky worker for a batch key, if it is still a legal target.
+        An overloaded / vanished / no-longer-eligible sticky worker returns
+        "" so the scan below elects (and records) a new one — the whole
+        key's queue migrates together instead of smearing across workers."""
+        ent = self._affinity.get(key)
+        if ent is None:
+            return ""
+        worker_id, stamped = ent
+        if time.monotonic() - stamped >= BATCH_AFFINITY_TTL_S:
+            self._affinity.pop(key, None)
+            return ""
+        hb = self.registry.get(worker_id)
+        if hb is None or is_overloaded(hb):
+            return ""
+        pool = next((p for p in pools if p.name == hb.pool), None)
+        if pool is None:
+            return ""
+        if not worker_satisfies(hb, pool, job_requires):
+            return ""
+        if placement and any(hb.labels.get(k) != v for k, v in placement.items()):
+            return ""
+        self._affinity[key] = (worker_id, time.monotonic())  # sliding TTL
+        return worker_id
 
     def _native_pick(self, req: JobRequest, pools, job_requires) -> Optional[str]:
         """Native packed scan for the common shape; LookupError → python."""
@@ -181,10 +227,20 @@ class LeastLoadedStrategy(Strategy):
             if hinted:
                 pools = hinted
 
+        # batch affinity: same-key jobs ride to the sticky worker so its
+        # micro-batch queues fill (explicit worker hints still win above)
+        batch_key = labels.get(LABEL_BATCH_KEY, "")
+        if batch_key:
+            sticky = self._affinity_worker(batch_key, pools, job_requires, placement)
+            if sticky:
+                return direct_subject(sticky)
+
         # native packed scan (the hot path: no hints, uniform pools)
         if not placement and not preferred_worker:
             try:
                 winner = self._native_pick(req, pools, job_requires)
+                if winner and batch_key:
+                    self._record_affinity(batch_key, winner)
                 return direct_subject(winner) if winner else req.topic
             except LookupError:
                 pass  # shapes the C kernel doesn't model → python scan
@@ -211,5 +267,7 @@ class LeastLoadedStrategy(Strategy):
                 best_score = score
                 best_worker = hb.worker_id
         if best_worker:
+            if batch_key:
+                self._record_affinity(batch_key, best_worker)
             return direct_subject(best_worker)
         return req.topic

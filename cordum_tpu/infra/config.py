@@ -65,6 +65,10 @@ class Pool:
     min_chips: int = 0
     topology: str = ""  # e.g. "2x2x1"; empty = any
     device_kind: str = ""  # e.g. "TPU v5p"; empty = any
+    # micro-batching limits for this pool's workers (cordum_tpu/batching);
+    # 0 = the worker's built-in defaults
+    max_batch_size: int = 0  # rows per flushed XLA call
+    max_batch_wait_ms: float = 0.0  # adaptive-window ceiling
 
 
 @dataclass
@@ -99,6 +103,8 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
             min_chips=int(p.get("min_chips") or 0),
             topology=str(p.get("topology") or ""),
             device_kind=str(p.get("device_kind") or ""),
+            max_batch_size=int(p.get("max_batch_size") or 0),
+            max_batch_wait_ms=float(p.get("max_batch_wait_ms") or 0.0),
         )
     for topic, pools in (doc.get("topics") or {}).items():
         if isinstance(pools, str):

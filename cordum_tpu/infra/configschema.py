@@ -55,6 +55,9 @@ POOLS_SCHEMA: dict[str, Any] = {
                         "min_chips": _NONNEG_INT,
                         "topology": {"type": "string", "pattern": r"^(\d+x\d+(x\d+)?)?$"},
                         "device_kind": {"type": "string"},
+                        # micro-batching limits (cordum_tpu/batching)
+                        "max_batch_size": _NONNEG_INT,
+                        "max_batch_wait_ms": _NONNEG,
                     },
                     "additionalProperties": False,
                 }],

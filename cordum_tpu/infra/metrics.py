@@ -150,6 +150,19 @@ class Metrics:
         self.workflow_steps = Counter("cordum_workflow_steps_total", "Workflow steps dispatched")
         self.workers_live = Gauge("cordum_workers_live", "Live workers in registry")
         self.tpu_duty_cycle = Gauge("cordum_tpu_duty_cycle", "Reported TPU duty cycle per worker")
+        # micro-batching (cordum_tpu/batching): rows-per-flush distribution,
+        # live queued rows per (op, bucket), flush count
+        self.batch_size = Histogram(
+            "cordum_batch_size",
+            "Rows per flushed micro-batch",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
+        )
+        self.batch_queue_depth = Gauge(
+            "cordum_batch_queue_depth", "Rows waiting in micro-batch queues"
+        )
+        self.batch_flushes = Counter(
+            "cordum_batch_flushes_total", "Micro-batch flushes executed"
+        )
         self._families = [
             self.jobs_received,
             self.jobs_dispatched,
@@ -166,6 +179,9 @@ class Metrics:
             self.workflow_steps,
             self.workers_live,
             self.tpu_duty_cycle,
+            self.batch_size,
+            self.batch_queue_depth,
+            self.batch_flushes,
         ]
 
     def render(self) -> str:

@@ -53,13 +53,23 @@ def tokenize(text: str, cfg: EmbedderConfig) -> list[int]:
     return ids
 
 
-def batch_tokenize(texts: Sequence[str], cfg: EmbedderConfig) -> tuple[np.ndarray, np.ndarray]:
-    """(ids [B, max_len] int32, mask [B, max_len] float32)."""
+def token_count(text: str, cfg: EmbedderConfig) -> int:
+    """Exact tokenized length of ``text`` (CLS included, capped at max_len)
+    without building the row — the micro-batcher's length-bucket key."""
+    return min(1 + len(_TOKEN_RE.findall(text.lower())), cfg.max_len)
+
+
+def batch_tokenize(
+    texts: Sequence[str], cfg: EmbedderConfig, *, max_len: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """(ids [B, L] int32, mask [B, L] float32); ``L`` = ``max_len`` (bucket
+    length, capped at the model max) or the model max when 0."""
+    length = min(max_len, cfg.max_len) if max_len else cfg.max_len
     b = len(texts)
-    ids = np.zeros((b, cfg.max_len), np.int32)
-    mask = np.zeros((b, cfg.max_len), np.float32)
+    ids = np.zeros((b, length), np.int32)
+    mask = np.zeros((b, length), np.float32)
     for i, t in enumerate(texts):
-        row = tokenize(t, cfg)
+        row = tokenize(t, cfg)[:length]
         ids[i, : len(row)] = row
         mask[i, : len(row)] = 1.0
     return ids, mask
@@ -143,12 +153,18 @@ class Embedder:
 
     def embed(self, texts: Sequence[str]) -> np.ndarray:
         ids, mask = batch_tokenize(texts, self.cfg)
+        return self.embed_tokens(ids, mask)
+
+    def embed_tokens(self, ids: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        """Forward pre-tokenized (already padded/bucketed) rows — the
+        micro-batcher's entry point; ``embed`` is tokenizer + this."""
+        b = ids.shape[0]
         if self._data_sharding is not None:
-            pad = -len(texts) % self.mesh.devices.size
+            pad = -b % self.mesh.devices.size
             if pad:
                 ids = np.pad(ids, ((0, pad), (0, 0)))
                 mask = np.pad(mask, ((0, pad), (0, 0)))
             ids = jax.device_put(ids, self._data_sharding)
             mask = jax.device_put(mask, self._data_sharding)
         out = np.asarray(self._fwd(self.params, jnp.asarray(ids), jnp.asarray(mask)))
-        return out[: len(texts)]
+        return out[:b]

@@ -574,3 +574,29 @@ LABEL_BUS_MSG_ID = "cordum.bus_msg_id"
 LABEL_DRY_RUN = "cordum.dry_run"
 LABEL_SECRETS_PRESENT = "secrets_present"
 ENV_EFFECTIVE_CONFIG = "CORDUM_EFFECTIVE_CONFIG"
+
+# ---------------------------------------------------------------------------
+# micro-batching declaration (cordum_tpu/batching)
+# ---------------------------------------------------------------------------
+
+# Ops whose jobs the worker-side micro-batcher may coalesce into one padded
+# XLA call.  Batchable = the op is a pure per-row computation (row i of the
+# batched program equals the row run alone), so results scatter back as
+# ordinary per-job JobResults.
+BATCHABLE_OPS = frozenset({"embed", "infer"})
+
+# Batch-routing label: the gateway stamps it at submit so the scheduler can
+# route same-key jobs to the same worker (batch affinity) without reading
+# the payload behind the context pointer.
+LABEL_BATCH_KEY = "cordum.batch_key"
+
+
+def payload_batch_key(payload: Any) -> str:
+    """The batch key for a job payload: the batchable op name, or ``""``
+    when the payload is not a batchable shape.  Key equality is the
+    contract: two jobs with the same key may share one XLA program."""
+    if isinstance(payload, dict):
+        op = payload.get("op")
+        if isinstance(op, str) and op in BATCHABLE_OPS:
+            return op
+    return ""

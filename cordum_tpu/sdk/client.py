@@ -93,6 +93,12 @@ class Client:
             body["memory_id"] = memory_id
         return await self._req("POST", "/api/v1/jobs", json=body)
 
+    async def submit_jobs(self, jobs: list[dict]) -> dict:
+        """Bulk submit via ``POST /api/v1/jobs:batch``: each entry is a
+        single-submit body; per-job verdicts come back positionally in
+        ``jobs`` (accepted entries carry ``job_id``/``trace_id``)."""
+        return await self._req("POST", "/api/v1/jobs:batch", json={"jobs": jobs})
+
     async def job_status(self, job_id: str, *, events: bool = False, result: bool = False) -> dict:
         q = []
         if events:

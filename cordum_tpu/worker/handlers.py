@@ -70,6 +70,7 @@ class TPUCompute:
         self._llama_params = None
         self._llama_fwd = None
         self._matmul_cache: dict[tuple, Any] = {}
+        self._batch_shapes: set[tuple] = set()  # compile_cached span attr
         self._seed = seed
 
     # -- matmul -----------------------------------------------------------
@@ -137,13 +138,75 @@ class TPUCompute:
         t = max(len(r) for r in tokens)
         t = min(max_len or cfg.max_seq_len, max(t, 1))
         batch = np.zeros((len(tokens), t), np.int32)
+        lens = []
         for i, row in enumerate(tokens):
             row = [min(x, cfg.vocab_size - 1) for x in row[:t]]
             batch[i, : len(row)] = row
+            lens.append(max(1, len(row)))
         with _maybe_timer(timer, op="infer", compile_cached=str(compiled).lower()):
             logits = self._llama_fwd(self._llama_params, jnp.asarray(batch))
-            next_tokens = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1)).tolist()
+            # score each row at ITS last real token (causal attention makes
+            # this invariant to right-padding, so per-job and micro-batched
+            # inference agree bit-for-bit in exact arithmetic)
+            last = logits[jnp.arange(len(tokens)), jnp.asarray(lens) - 1]
+            next_tokens = np.asarray(jnp.argmax(last, axis=-1)).tolist()
         return {"next_tokens": next_tokens, "seq_len": t}
+
+    # -- micro-batch entry points -----------------------------------------
+    def embed_batch(self, texts: list[str], *, seq_len: int = 0,
+                    batch_buckets=None, timer=None):
+        """One padded XLA call embedding many jobs' texts: sequence dim
+        trimmed to the queue's length bucket, batch dim padded up to a
+        power-of-two bucket so XLA keeps one program per (batch, seq)
+        bucket pair."""
+        import numpy as np
+
+        from ..batching.buckets import bucket_for, pow2_buckets
+        from ..models.embedder import batch_tokenize
+
+        cfg = self.embedder.cfg
+        ids, mask = batch_tokenize(texts, cfg, max_len=seq_len or cfg.max_len)
+        b = len(texts)
+        bpad = bucket_for(b, batch_buckets or pow2_buckets(1, 256))
+        if bpad > b:
+            ids = np.pad(ids, ((0, bpad - b), (0, 0)))
+            mask = np.pad(mask, ((0, bpad - b), (0, 0)))
+        shape = ("embed", bpad, ids.shape[1])
+        compiled = shape in self._batch_shapes
+        self._batch_shapes.add(shape)
+        with _maybe_timer(timer, op="embed_batch", compile_cached=str(compiled).lower()):
+            out = self.embedder.embed_tokens(ids, mask)
+        return np.asarray(out)[:b]
+
+    def infer_batch(self, rows: list[list[int]], *, seq_len: int = 0,
+                    batch_buckets=None, timer=None):
+        """One padded XLA call scoring many jobs' rows; each row's next
+        token is gathered at its own last real position (causal attention
+        makes the right-padding inert).  Returns (next_tokens, seq_len)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..batching.buckets import bucket_for, pow2_buckets
+
+        self._ensure_llama()
+        cfg = self.llama_cfg
+        t = min(max(1, seq_len or max((len(r) for r in rows), default=1)), cfg.max_seq_len)
+        b = len(rows)
+        bpad = bucket_for(b, batch_buckets or pow2_buckets(1, 256))
+        batch = np.zeros((bpad, t), np.int32)
+        lens = np.ones((bpad,), np.int32)
+        for i, row in enumerate(rows):
+            row = [min(x, cfg.vocab_size - 1) for x in row[:t]]
+            batch[i, : len(row)] = row
+            lens[i] = max(1, len(row))
+        shape = ("infer", bpad, t)
+        compiled = shape in self._batch_shapes
+        self._batch_shapes.add(shape)
+        with _maybe_timer(timer, op="infer_batch", compile_cached=str(compiled).lower()):
+            logits = self._llama_fwd(self._llama_params, jnp.asarray(batch))
+            last = logits[jnp.arange(bpad), jnp.asarray(lens) - 1]
+            next_tokens = np.asarray(jnp.argmax(last, axis=-1))[:b].tolist()
+        return next_tokens, t
 
 
 def make_tpu_handlers(compute: TPUCompute):
@@ -212,8 +275,116 @@ def make_tpu_handlers(compute: TPUCompute):
     return handler
 
 
-def attach_default_tpu_worker(worker: Worker, *, tp: int = 1, **kw) -> TPUCompute:
-    """Wire the standard TPU op handlers onto a worker."""
+def make_micro_batcher(
+    compute: TPUCompute,
+    worker: Worker,
+    *,
+    max_batch_rows: int = 32,
+    max_wait_ms: float = 25.0,
+    metrics=None,
+):
+    """Build the worker's micro-batcher over ``compute``'s batch entry
+    points: payload decomposition (``parts_fn``) + the padded-XLA flush.
+    Invalid payload shapes decompose to None so they keep the per-job
+    handler path and fail with the op's own pointed error."""
+    import numpy as np
+
+    from ..batching.buckets import pow2_buckets
+    from ..batching.engine import BatchParts, MicroBatcher
+    from ..models.embedder import token_count
+
+    ecfg = compute.embedder.cfg
+    lcfg = compute.llama_cfg
+
+    def parts_fn(payload) -> "BatchParts | None":
+        if not isinstance(payload, dict):
+            return None
+        op = payload.get("op")
+        if op == "embed":
+            texts = payload.get("texts")
+            if isinstance(texts, list) and texts and all(isinstance(t, str) for t in texts):
+                return BatchParts(
+                    "embed", texts, len(texts),
+                    max(token_count(t, ecfg) for t in texts),
+                )
+        elif op == "infer":
+            tokens = payload.get("tokens")
+            if payload.get("max_len"):
+                return None  # explicit padding request: keep per-job semantics
+            if (
+                isinstance(tokens, list) and tokens
+                and all(isinstance(r, list) and r
+                        and all(isinstance(x, int) for x in r) for r in tokens)
+            ):
+                length = min(max(len(r) for r in tokens), lcfg.max_seq_len)
+                return BatchParts("infer", tokens, len(tokens), length)
+        return None
+
+    async def flush_fn(op, bucket, items):
+        if op == "embed":
+            texts = [t for it in items for t in it.rows]
+
+            def run_embed():
+                return compute.embed_batch(texts, seq_len=bucket)
+
+            vecs = await worker.run_in_executor(run_embed)
+            out, i = [], 0
+            for it in items:
+                out.append({
+                    "embeddings": np.asarray(vecs[i:i + it.n_rows]).tolist(),
+                    "dim": int(vecs.shape[1]),
+                    "batched": True,
+                })
+                i += it.n_rows
+            return out
+        if op == "infer":
+            rows = [r for it in items for r in it.rows]
+
+            def run_infer():
+                return compute.infer_batch(rows, seq_len=bucket)
+
+            toks, t = await worker.run_in_executor(run_infer)
+            out, i = [], 0
+            for it in items:
+                out.append({
+                    "next_tokens": toks[i:i + it.n_rows],
+                    "seq_len": t,
+                    "batched": True,
+                })
+                i += it.n_rows
+            return out
+        raise HandlerError(f"unbatchable op {op!r}")
+
+    seq_cap = max(ecfg.max_len, min(lcfg.max_seq_len, 512))
+    return MicroBatcher(
+        flush_fn,
+        parts_fn=parts_fn,
+        max_batch_rows=max_batch_rows,
+        max_wait_ms=max_wait_ms,
+        len_buckets=pow2_buckets(16, seq_cap),
+        metrics=metrics,
+        tracer=worker.tracer,
+    )
+
+
+def attach_default_tpu_worker(
+    worker: Worker,
+    *,
+    tp: int = 1,
+    batching: bool = True,
+    max_batch_rows: int = 32,
+    max_batch_wait_ms: float = 25.0,
+    metrics=None,
+    **kw,
+) -> TPUCompute:
+    """Wire the standard TPU op handlers (and, by default, the micro-batcher
+    over the batchable ops) onto a worker."""
     compute = TPUCompute(tp=tp, **kw)
     worker.register_default(make_tpu_handlers(compute))
+    if batching:
+        worker.attach_batcher(make_micro_batcher(
+            compute, worker,
+            max_batch_rows=max_batch_rows, max_wait_ms=max_batch_wait_ms,
+            metrics=metrics,
+        ))
     return compute

@@ -15,6 +15,10 @@ TPU-native deltas (the north star's in-tree TPU worker):
     HBM use, duty-cycle estimate) for slice-aware scheduling
   * cooperative cancel: handlers receive a :class:`JobContext` whose
     ``cancelled`` event they may poll between jitted steps
+  * micro-batching: with a batcher attached (``attach_batcher``), batchable
+    jobs (embed/infer) bypass the per-job semaphore, queue per
+    (op, length-bucket), and flush as one padded XLA call — results still
+    publish as ordinary per-job ``JobResult``s (docs/BATCHING.md)
 """
 from __future__ import annotations
 
@@ -26,6 +30,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Optional
 
+from ..batching.engine import BatchCancelled, BatchParts, MicroBatcher
 from ..infra import logging as logx
 from ..infra.bus import Bus
 from ..infra.memstore import MemoryStore
@@ -44,6 +49,9 @@ from ..protocol.types import (
 from ..utils.ids import new_id
 
 HEARTBEAT_INTERVAL_S = 10.0
+
+# sentinel: payload not yet fetched from the memory store
+_UNFETCHED = object()
 
 
 class JobCancelled(Exception):
@@ -135,6 +143,9 @@ class Worker:
         self._hb_task: Optional[asyncio.Task] = None
         self._executor = ThreadPoolExecutor(max_workers=max_parallel_jobs, thread_name_prefix=f"{worker_id}-jax")
         self.tracer = Tracer("worker", bus)
+        # optional micro-batcher (cordum_tpu/batching): batchable jobs bypass
+        # the per-job semaphore and coalesce into bucketed XLA calls
+        self._batcher: Optional[MicroBatcher] = None
         self._telemetry = _device_telemetry()
         self._busy_since: Optional[float] = None
         self._busy_accum = 0.0
@@ -148,6 +159,17 @@ class Worker:
 
     def register_default(self, handler: Handler) -> None:
         self._default_handler = handler
+
+    def attach_batcher(self, batcher: MicroBatcher) -> None:
+        """Wire a micro-batcher between job intake and the XLA handlers.
+        Jobs whose payload the batcher recognizes (``batcher.parts``) are
+        queued and flushed as one padded XLA call; everything else keeps the
+        per-job handler path."""
+        self._batcher = batcher
+
+    @property
+    def batcher(self) -> Optional[MicroBatcher]:
+        return self._batcher
 
     async def run_in_executor(self, fn, *args):
         """Run a blocking JAX computation off the event loop."""
@@ -176,23 +198,61 @@ class Worker:
         for s in self._subs:
             s.unsubscribe()
         self._subs = []
+        if self._batcher is not None:
+            await self._batcher.stop()  # drain queued batches before the pool dies
         self._executor.shutdown(wait=False)
 
     # ------------------------------------------------------------------
     async def _on_cancel(self, subject: str, pkt: BusPacket) -> None:
         c = pkt.job_cancel
-        if c and c.job_id in self._active:
+        if c is None or not c.job_id:
+            return
+        if c.job_id in self._active:
             self._active[c.job_id].cancelled.set()
+        if self._batcher is not None:
+            # still waiting in a batch queue: pull it out so it does not ride
+            # in the flush; its waiter raises BatchCancelled and the job
+            # publishes an ordinary CANCELLED result
+            self._batcher.cancel(c.job_id)
 
     async def _on_job(self, subject: str, pkt: BusPacket) -> None:
         req = pkt.job_request
         if req is None or not req.job_id:
             return
+        payload: Any = _UNFETCHED
+        batch_parts: Optional[BatchParts] = None
+        if (
+            self._batcher is not None
+            and req.job_id not in self._active
+            and req.job_id not in self._completed
+            # explicit topic/adapter handlers win over the batch path
+            and self._handlers.get(req.topic) is None
+            and self._handlers.get(req.adapter_id) is None
+        ):
+            payload = await self.store.get_pointer(req.context_ptr) if req.context_ptr else None
+            batch_parts = self._batcher.parts(payload)
+        if batch_parts is not None:
+            # batchable: no semaphore slot — a queued job must not starve the
+            # per-job lanes while it waits for batch-mates; the batcher's
+            # window + the executor pool bound the actual device concurrency
+            await self._run_job(
+                req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id,
+                payload=payload, batch_parts=batch_parts,
+            )
+            return
         async with self._sem:
-            await self._run_job(req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id)
+            await self._run_job(
+                req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id, payload=payload
+            )
 
     async def _run_job(
-        self, req: JobRequest, *, trace_id: str = "", parent_span_id: str = ""
+        self,
+        req: JobRequest,
+        *,
+        trace_id: str = "",
+        parent_span_id: str = "",
+        payload: Any = _UNFETCHED,
+        batch_parts: Optional[BatchParts] = None,
     ) -> None:
         if req.job_id in self._active:
             return  # redelivery of an in-flight job
@@ -207,9 +267,8 @@ class Worker:
                 subj.RESULT, BusPacket.wrap(copy, trace_id=trace_id, sender_id=self.worker_id)
             )
             return
-        payload = None
-        if req.context_ptr:
-            payload = await self.store.get_pointer(req.context_ptr)
+        if payload is _UNFETCHED:
+            payload = await self.store.get_pointer(req.context_ptr) if req.context_ptr else None
         ctx = JobContext(request=req, payload=payload, worker=self)
         self._active[req.job_id] = ctx
         self._mark_busy()
@@ -226,22 +285,39 @@ class Worker:
         error_code = error_message = ""
         result_ptr = ""
         try:
-            handler = self._handlers.get(req.topic) or self._handlers.get(req.adapter_id) or self._default_handler
-            if handler is None:
-                raise RuntimeError(f"no handler for topic {req.topic!r}")
-            import inspect
-
-            if inspect.iscoroutinefunction(handler):
-                out = await handler(ctx)
+            if batch_parts is not None and self._batcher is not None:
+                # micro-batch path: park in the (op, bucket) queue and await
+                # the scattered slice of the flushed XLA call.  The flush
+                # writes batch_size / batch_queue_wait_ms straight into the
+                # execute span's attrs via the sink.
+                exec_span.attrs["batched"] = "true"
+                out = await self._batcher.submit(
+                    batch_parts.op,
+                    batch_parts.rows,
+                    job_id=req.job_id,
+                    length=batch_parts.length,
+                    n_rows=batch_parts.n_rows,
+                    trace_id=trace_id,
+                    parent_span_id=exec_span.span_id,
+                    attr_sink=exec_span.attrs,
+                )
             else:
-                # sync handler: enforce executor dispatch so blocking JAX
-                # work cannot stall the loop (heartbeats keep flowing)
-                out = await self.run_in_executor(handler, ctx)
-                if inspect.isawaitable(out):  # sync fn returned a coroutine
-                    out = await out
+                handler = self._handlers.get(req.topic) or self._handlers.get(req.adapter_id) or self._default_handler
+                if handler is None:
+                    raise RuntimeError(f"no handler for topic {req.topic!r}")
+                import inspect
+
+                if inspect.iscoroutinefunction(handler):
+                    out = await handler(ctx)
+                else:
+                    # sync handler: enforce executor dispatch so blocking JAX
+                    # work cannot stall the loop (heartbeats keep flowing)
+                    out = await self.run_in_executor(handler, ctx)
+                    if inspect.isawaitable(out):  # sync fn returned a coroutine
+                        out = await out
             if out is not None:
                 result_ptr = await self.store.put_result(req.job_id, out)
-        except JobCancelled:
+        except (JobCancelled, BatchCancelled):
             status = JobState.CANCELLED.value
             error_code, error_message = "CANCELLED", "cancelled"
         except asyncio.CancelledError:

@@ -188,6 +188,51 @@ async def test_job_submit_validation_and_idempotency():
         assert j1["job_id"] == j2["job_id"] and j2.get("deduplicated")
 
 
+async def test_bulk_submit_roundtrip():
+    """POST /api/v1/jobs:batch: one round trip, per-job verdicts, bad jobs
+    isolated, batchable payloads stamped with the batch-key label."""
+    async with GwStack() as s:
+        r = await s.client.post("/api/v1/jobs:batch", json={"jobs": [
+            {"topic": "job.work", "payload": {"n": 0}},
+            {"topic": "job.work", "payload": {"op": "embed", "texts": ["hi"]}},
+            {"payload": {"missing": "topic"}},
+        ]}, headers=s.h())
+        assert r.status == 202
+        doc = await r.json()
+        assert doc["accepted"] == 2 and doc["rejected"] == 1
+        assert doc["jobs"][2]["status"] == 400 and "topic" in doc["jobs"][2]["error"]
+        await s.settle()
+        for entry in doc["jobs"][:2]:
+            meta = await s.job_store.get_meta(entry["job_id"])
+            assert meta["state"] == "SUCCEEDED", meta
+        # the embed job carries the batch-routing label for affinity
+        req = await s.job_store.get_request(doc["jobs"][1]["job_id"])
+        assert req.labels.get("cordum.batch_key") == "embed"
+        req0 = await s.job_store.get_request(doc["jobs"][0]["job_id"])
+        assert "cordum.batch_key" not in (req0.labels or {})
+
+
+async def test_bulk_submit_validation():
+    async with GwStack() as s:
+        r = await s.client.post("/api/v1/jobs:batch", json={"jobs": []}, headers=s.h())
+        assert r.status == 400
+        r = await s.client.post("/api/v1/jobs:batch", json={}, headers=s.h())
+        assert r.status == 400
+        # every job rejected → 400, verdicts still positional
+        r = await s.client.post("/api/v1/jobs:batch",
+                                json={"jobs": [{"payload": {}}, "not-a-dict"]},
+                                headers=s.h())
+        assert r.status == 400
+        doc = await r.json()
+        assert doc["accepted"] == 0 and len(doc["jobs"]) == 2
+        from cordum_tpu.controlplane.gateway.app import MAX_BULK_JOBS
+
+        too_many = [{"topic": "job.work"}] * (MAX_BULK_JOBS + 1)
+        r = await s.client.post("/api/v1/jobs:batch", json={"jobs": too_many},
+                                headers=s.h())
+        assert r.status == 400
+
+
 async def test_secret_detection_labels():
     async with GwStack() as s:
         r = await s.client.post(

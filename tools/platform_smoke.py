@@ -13,6 +13,9 @@ TPU worker as separate OS processes, then over plain HTTP:
   5. flight recorder: traced job → span waterfall (≥5 spans, ≥4 services),
      cordum_stage_seconds in /metrics, `cordum trace` CLI render
   6. approval-only workflow → approve step → run succeeded
+  7. micro-batching: bulk fan-out of ≥32 embed jobs through
+     POST /api/v1/jobs:batch coalesces on the worker — at least one flushed
+     batch of size ≥8, asserted via the batch span attributes
 
 Exit 0 = PASS.  Usage: python tools/platform_smoke.py [--keep]
 """
@@ -73,7 +76,12 @@ def spawn_stack(logdir: str) -> list[subprocess.Popen]:
          {"WORKER_ID": "smoke-w1", "WORKER_POOL": "tpu",
           "WORKER_TOPICS": "job.tpu.>,job.default,job.hello-pack.echo",
           "WORKER_CAPABILITIES": "tpu,echo",
-          "WORKER_HEARTBEAT_INTERVAL": "1"}),
+          "WORKER_HEARTBEAT_INTERVAL": "1",
+          # wide micro-batch window: the smoke fan-out arrives spread over
+          # the dispatch pipeline's per-job latency, and step 7 asserts a
+          # flushed batch of >= 8 (docs/BATCHING.md tuning knobs)
+          "WORKER_MAX_BATCH_SIZE": "32",
+          "WORKER_BATCH_WAIT_MS": "900"}),
     ]
     # config files used by scheduler + kernel
     with open(os.path.join(logdir, "pools.yaml"), "w") as f:
@@ -291,6 +299,37 @@ def main() -> int:
             assert r.status_code == 200, r.text
             wait_run(c, run_id, "SUCCEEDED")
             log("6. guarded-inference run approved → SUCCEEDED")
+
+            # 7. micro-batching: a bulk fan-out of 32 single-text embed jobs
+            # must coalesce on the worker — at least one flushed batch of
+            # size >= 8, proven by the batch attributes the flush writes
+            # onto the execute spans
+            n_fan = 32
+            r = c.post("/api/v1/jobs:batch", json={"jobs": [
+                {"topic": "job.tpu.ops",
+                 "payload": {"op": "embed",
+                             "texts": [f"microbatch smoke document {i}"]}}
+                for i in range(n_fan)]})
+            assert r.status_code == 202, r.text
+            docs = r.json()["jobs"]
+            assert len(docs) == n_fan and all(d.get("job_id") for d in docs), docs
+            for d in docs:
+                wait_job(c, d["job_id"], "SUCCEEDED")
+            best = 0
+            t0 = time.time()
+            while time.time() - t0 < 30 and best < 8:
+                best = 0
+                for d in docs:
+                    trace = c.get(f"/api/v1/traces/{d['trace_id']}").json()
+                    for sp in trace.get("spans") or []:
+                        size = (sp.get("attrs") or {}).get("batch_size", "")
+                        if size.isdigit():
+                            best = max(best, int(size))
+                if best < 8:
+                    time.sleep(0.5)
+            assert best >= 8, f"largest flushed batch was {best}, wanted >= 8"
+            log(f"7. bulk fan-out of {n_fan} embed jobs coalesced "
+                f"(largest flushed batch {best})")
 
         log("PASS")
         return 0
