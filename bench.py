@@ -38,6 +38,7 @@ import time
 N_JOBS = int(os.environ.get("BENCH_JOBS", "3000"))
 PACED_JOBS = int(os.environ.get("BENCH_PACED_JOBS", "1500"))
 PACED_RATE = float(os.environ.get("BENCH_PACED_RATE", "1000"))  # jobs/s offered
+STATEBUS_JOBS = int(os.environ.get("BENCH_STATEBUS_JOBS", "600"))
 JAX_TIMEOUT_S = float(os.environ.get("BENCH_JAX_TIMEOUT_S", "420"))
 BASELINE_JOBS_PER_SEC = 1000.0  # BASELINE.json north-star target
 
@@ -111,9 +112,16 @@ async def bench_scheduler() -> dict:
         await asyncio.sleep(0.01)
     dt = time.perf_counter() - t0
     n = eng.metrics.jobs_completed.value(status="SUCCEEDED")
+    # per-job KV chatter on the full submit→result loop (the engine binds
+    # cordum_kv_roundtrips_total to its store; ISSUE 4 acceptance metric)
+    roundtrips = eng.metrics.kv_roundtrips.total()
     await eng.stop()
     await bus.close()
-    return {"jobs": int(n), "seconds": dt, "jobs_per_sec": n / dt if dt > 0 else 0.0}
+    return {
+        "jobs": int(n), "seconds": dt,
+        "jobs_per_sec": n / dt if dt > 0 else 0.0,
+        "kv_roundtrips_per_job": roundtrips / n if n else 0.0,
+    }
 
 
 async def bench_latency() -> dict:
@@ -220,6 +228,114 @@ async def bench_latency() -> dict:
         "p99_e2e_ms": q(0.99),
         "stage_p50_ms": {k: round(v, 3) for k, v in stages.items()},
     }
+
+
+class _PerOpPipelineKV:
+    """Bench-only degraded KV: delegates every op to the wrapped StateBusKV
+    but downgrades ``pipeline()`` to one wire call PER buffered op (plus a
+    version read per watch) — the pre-pipelining wire behavior, so the
+    statebus bench can report before/after on the same run."""
+
+    def __init__(self, kv):
+        self._kv = kv
+
+    def __getattr__(self, name):
+        return getattr(self._kv, name)
+
+    def pipeline(self):
+        from cordum_tpu.infra.kv import Pipeline
+
+        class _PerOp(Pipeline):
+            async def execute(self) -> bool:
+                kv = self._kv
+                for key, ver in self._watches.items():
+                    if await kv.version(key) != ver:
+                        return False
+                for op in self._ops:
+                    name, *args = op
+                    await getattr(kv, name)(*args)
+                self.new_versions = {k: await kv.version(k) for k in self._watches}
+                return True
+
+        return _PerOp(self._kv)
+
+
+async def bench_statebus(pipelined: bool, n_jobs: int) -> dict:
+    """The schedule loop against a REAL TCP StateBusServer (the deployment
+    the pipelining work targets): scheduler and worker hold separate
+    connections, every KV op is a genuine wire round trip."""
+    from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+    from cordum_tpu.controlplane.scheduler.engine import Engine
+    from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.jobstore import JobStore
+    from cordum_tpu.infra.registry import WorkerRegistry
+    from cordum_tpu.infra.statebus import StateBusServer, connect
+    from cordum_tpu.protocol import subjects as subj
+    from cordum_tpu.protocol.types import BusPacket, Heartbeat, JobRequest, JobResult
+
+    srv = StateBusServer(port=0)
+    await srv.start()
+    url = f"statebus://127.0.0.1:{srv.port}"
+    skv, sbus, sconn = await connect(url)  # scheduler "process"
+    wkv, wbus, wconn = await connect(url)  # worker "process"
+    try:
+        kv = skv if pipelined else _PerOpPipelineKV(skv)
+        js = JobStore(kv)
+        kernel = SafetyKernel(
+            policy_doc={"tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}}
+        )
+        reg = WorkerRegistry()
+        pc = parse_pool_config(
+            {"topics": {"job.bench": "bench"}, "pools": {"bench": {"requires": []}}}
+        )
+        eng = Engine(
+            bus=sbus, job_store=js, safety=SafetyClient(kernel.check),
+            strategy=LeastLoadedStrategy(reg, pc), registry=reg,
+        )
+        reg.update(Heartbeat(worker_id="bench-w", pool="bench", max_parallel_jobs=1 << 30))
+        await eng.start()
+
+        async def worker_handler(subject, pkt):
+            req = pkt.job_request
+            await wbus.publish(
+                subj.RESULT,
+                BusPacket.wrap(
+                    JobResult(job_id=req.job_id, status="SUCCEEDED", worker_id="bench-w"),
+                    sender_id="bench-w",
+                ),
+            )
+
+        await wbus.subscribe(subj.direct_subject("bench-w"), worker_handler, queue="w")
+
+        t0 = time.perf_counter()
+        for i in range(n_jobs):
+            await sbus.publish(
+                subj.SUBMIT,
+                BusPacket.wrap(
+                    JobRequest(job_id=f"sb-{i}", topic="job.bench", tenant_id="default"),
+                    sender_id="bench",
+                ),
+            )
+        deadline = time.perf_counter() + 120
+        while time.perf_counter() < deadline:
+            if eng.metrics.jobs_completed.value(status="SUCCEEDED") >= n_jobs:
+                break
+            await asyncio.sleep(0.01)
+        dt = time.perf_counter() - t0
+        n = eng.metrics.jobs_completed.value(status="SUCCEEDED")
+        roundtrips = eng.metrics.kv_roundtrips.total()
+        await eng.stop()
+        return {
+            "jobs": int(n),
+            "jobs_per_sec": n / dt if dt > 0 else 0.0,
+            "kv_roundtrips_per_job": roundtrips / n if n else 0.0,
+        }
+    finally:
+        await sconn.close()
+        await wconn.close()
+        await srv.stop()
 
 
 def bench_selection() -> dict:
@@ -550,8 +666,11 @@ def main() -> None:
         PACED_JOBS = min(PACED_JOBS, 200)
         PACED_RATE = min(PACED_RATE, 500.0)
         JAX_TIMEOUT_S = min(JAX_TIMEOUT_S, 240.0)
+    sb_jobs = min(STATEBUS_JOBS, 150) if smoke else STATEBUS_JOBS
     sched = asyncio.run(bench_scheduler())
     lat = asyncio.run(bench_latency())
+    sb_pipe = asyncio.run(bench_statebus(True, sb_jobs))
+    sb_perop = asyncio.run(bench_statebus(False, sb_jobs))
     sel = bench_selection()
     jx = bench_jax(smoke=smoke)
     out = {
@@ -560,6 +679,19 @@ def main() -> None:
         "unit": "jobs/s",
         "vs_baseline": round(sched["jobs_per_sec"] / BASELINE_JOBS_PER_SEC, 3),
         "jobs": sched["jobs"],
+        # KV round-trip budget (ISSUE 4): submit→result chatter per job
+        "kv_roundtrips_per_job": round(sched["kv_roundtrips_per_job"], 1),
+        # statebus mode: the same schedule loop over a real TCP statebus,
+        # pipelined vs. downgraded-to-per-op-calls on the same run
+        "statebus_jobs_per_sec": round(sb_pipe["jobs_per_sec"], 1),
+        "statebus_unpipelined_jobs_per_sec": round(sb_perop["jobs_per_sec"], 1),
+        "statebus_pipeline_speedup": round(
+            sb_pipe["jobs_per_sec"] / sb_perop["jobs_per_sec"], 2
+        ) if sb_perop["jobs_per_sec"] else 0.0,
+        "statebus_kv_roundtrips_per_job": round(sb_pipe["kv_roundtrips_per_job"], 1),
+        "statebus_unpipelined_kv_roundtrips_per_job": round(
+            sb_perop["kv_roundtrips_per_job"], 1
+        ),
         "p50_e2e_ms": round(lat.get("p50_e2e_ms", 0.0), 2),
         "p99_e2e_ms": round(lat.get("p99_e2e_ms", 0.0), 2),
         "stage_p50_ms": lat.get("stage_p50_ms", {}),

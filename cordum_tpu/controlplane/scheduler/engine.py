@@ -21,6 +21,7 @@ redesigned for asyncio + at-least-once redelivery:
 """
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 from typing import Optional
@@ -28,7 +29,7 @@ from typing import Optional
 from ...infra import logging as logx
 from ...infra.bus import Bus, RetryAfter
 from ...infra.configsvc import ConfigService
-from ...infra.jobstore import JobStore, SafetyDecisionRecord
+from ...infra.jobstore import JobStore, MetaSnapshot, SafetyDecisionRecord
 from ...infra.metrics import Metrics
 from ...infra.registry import WorkerRegistry
 from ...obs.tracer import Tracer
@@ -51,8 +52,15 @@ from .safety_client import SafetyClient
 from .strategy import Strategy
 
 DEFAULT_MAX_ATTEMPTS = 5
+DEFAULT_SUBMIT_CONCURRENCY = 64
 ENV_POLICY_CONSTRAINTS = "CORDUM_POLICY_CONSTRAINTS"
 ENV_MAX_CHIPS = "CORDUM_MAX_CHIPS"
+
+_INFLIGHT_STATES = (
+    JobState.SCHEDULED.value,
+    JobState.DISPATCHED.value,
+    JobState.RUNNING.value,
+)
 
 
 class Engine:
@@ -70,6 +78,7 @@ class Engine:
         max_attempts: int = DEFAULT_MAX_ATTEMPTS,
         tenant_concurrency_limit: int = 0,
         tracer: Optional[Tracer] = None,
+        submit_concurrency: int = DEFAULT_SUBMIT_CONCURRENCY,
     ):
         self.bus = bus
         self.tracer = tracer or Tracer("scheduler", bus)
@@ -82,7 +91,15 @@ class Engine:
         self.instance_id = instance_id
         self.max_attempts = max_attempts
         self.tenant_concurrency_limit = tenant_concurrency_limit
+        # jobs are processed concurrently (the per-job KV lock guarantees
+        # safety); the semaphore bounds in-flight work so a submit burst
+        # can't spawn unbounded tasks all hammering the state bus at once
+        self.submit_concurrency = max(1, submit_concurrency)
+        self._sem = asyncio.Semaphore(self.submit_concurrency)
         self._subs = []
+        # kv round-trip accounting (cordum_kv_roundtrips_total{op}) for the
+        # store this engine drives — the bench's kv_roundtrips_per_job source
+        job_store.kv.bind_metrics(self.metrics)
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -129,9 +146,10 @@ class Engine:
         req = pkt.job_request
         if req is None or not req.job_id or not req.topic:
             return
-        await self.handle_job_request(
-            req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
-        )
+        async with self._sem:
+            await self.handle_job_request(
+                req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
+            )
 
     async def handle_job_request(
         self, req: JobRequest, *, trace_id: str = "", parent_span_id: str = ""
@@ -139,53 +157,70 @@ class Engine:
         if not await self.job_store.acquire_job_lock(req.job_id, self.instance_id, ttl_s=30.0):
             raise RetryAfter(0.05, f"job {req.job_id} locked")
         try:
-            if await self.job_store.is_terminal(req.job_id):
-                return  # idempotency short-circuit under redelivery
-            self.metrics.jobs_received.inc(topic=req.topic)
-            st = await self.job_store.get_state(req.job_id)
-            if st in (
-                JobState.SCHEDULED.value,
-                JobState.DISPATCHED.value,
-                JobState.RUNNING.value,
-            ):
-                # In-flight short-circuit: a redelivered submit for a job
-                # already dispatched must not re-run the safety check, burn an
-                # attempt, or attempt an illegal →SCHEDULED transition (enough
-                # duplicates could otherwise DLQ a job that is still running).
-                return
-            if st == JobState.APPROVAL_REQUIRED.value:
-                # Parked jobs only move via a valid approval: the republish
-                # must carry the approval label AND hash-match the stored
-                # decision record; anything else must not clobber the parked
-                # request/record (attempted approval bypass otherwise).
-                stored = await self.job_store.get_safety_decision(req.job_id)
-                granted = (req.labels or {}).get(LABEL_APPROVAL_GRANTED) == "true"
-                if not (granted and stored and stored.job_hash == job_hash(req)):
-                    logx.warn(
-                        "ignoring republish of parked job without valid approval",
-                        job_id=req.job_id,
-                    )
+            submit_fields = {
+                "topic": req.topic,
+                "tenant_id": req.tenant_id,
+                "principal_id": req.principal_id,
+                "context_ptr": req.context_ptr,
+                "workflow_id": req.workflow_id,
+                "run_id": req.run_id,
+                "trace_id": trace_id,
+                "priority": req.priority,
+                "submitted_at_us": str(time.time_ns() // 1000),
+            }
+            create_extra = self.job_store.put_request_ops(req)
+            create_extra += self.job_store.add_to_trace_ops(trace_id, req.job_id)
+            # Optimistic fresh-job fast path: assume job:meta does not exist
+            # yet (version 0) and fold →PENDING + the request blob + trace
+            # membership into ONE pipelined commit — zero read round trips
+            # for the common case.  A conflict means the job already exists:
+            # apply_chain hands back a fresh snapshot to short-circuit on.
+            changed, snap = await self.job_store.apply_chain(
+                req.job_id,
+                [(JobState.PENDING, submit_fields, "submit")],
+                snap=MetaSnapshot(), extra_ops=create_extra, max_retries=1,
+            )
+            if changed is None:
+                st = snap.state
+                if snap.is_terminal:
+                    return  # idempotency short-circuit under redelivery
+                self.metrics.jobs_received.inc(topic=req.topic)
+                if st in _INFLIGHT_STATES:
+                    # In-flight short-circuit: a redelivered submit for a job
+                    # already dispatched must not re-run the safety check,
+                    # burn an attempt, or attempt an illegal →SCHEDULED
+                    # transition (enough duplicates could otherwise DLQ a job
+                    # that is still running).
                     return
-            await self.job_store.put_request(req)
-            if not st:
-                await self.job_store.set_state(
-                    req.job_id,
-                    JobState.PENDING,
-                    fields={
-                        "topic": req.topic,
-                        "tenant_id": req.tenant_id,
-                        "principal_id": req.principal_id,
-                        "context_ptr": req.context_ptr,
-                        "workflow_id": req.workflow_id,
-                        "run_id": req.run_id,
-                        "trace_id": trace_id,
-                        "priority": req.priority,
-                        "submitted_at_us": str(time.time_ns() // 1000),
-                    },
-                    event="submit",
-                )
-            if trace_id:
-                await self.job_store.add_to_trace(trace_id, req.job_id)
+                if st == JobState.APPROVAL_REQUIRED.value:
+                    # Parked jobs only move via a valid approval: the
+                    # republish must carry the approval label AND hash-match
+                    # the stored decision record; anything else must not
+                    # clobber the parked request/record (attempted approval
+                    # bypass otherwise).
+                    stored = await self.job_store.get_safety_decision(req.job_id)
+                    granted = (req.labels or {}).get(LABEL_APPROVAL_GRANTED) == "true"
+                    if not (granted and stored and stored.job_hash == job_hash(req)):
+                        logx.warn(
+                            "ignoring republish of parked job without valid approval",
+                            job_id=req.job_id,
+                        )
+                        return
+                    await self.job_store.put_request(req)
+                elif not st:
+                    # rare: meta expired between the failed create and the
+                    # re-read — walk the normal validated create with retries
+                    changed, snap = await self.job_store.apply_chain(
+                        req.job_id,
+                        [(JobState.PENDING, submit_fields, "submit")],
+                        snap=snap, extra_ops=create_extra,
+                    )
+                else:
+                    # PENDING redelivery: refresh the persisted request blob
+                    # only (the original submit fields stay authoritative)
+                    await self.job_store.put_request(req)
+            else:
+                self.metrics.jobs_received.inc(topic=req.topic)
             # schedule span: covers safety gate + strategy + dispatch; a
             # RetryAfter (throttle / tenant limit) surfaces as an ERROR span
             # with the exception type, then still drives redelivery
@@ -195,14 +230,23 @@ class Engine:
                 parent_span_id=parent_span_id,
                 attrs={"job_id": req.job_id, "topic": req.topic},
             ):
-                await self.process_job(req, trace_id=trace_id)
+                await self.process_job(req, trace_id=trace_id, snap=snap)
         finally:
             await self.job_store.release_job_lock(req.job_id, self.instance_id)
 
     # ------------------------------------------------------------------
-    async def process_job(self, req: JobRequest, *, trace_id: str = "") -> None:
-        meta = await self.job_store.get_meta(req.job_id)
-        await self._attach_effective_config(req)
+    async def process_job(
+        self, req: JobRequest, *, trace_id: str = "",
+        snap: Optional[MetaSnapshot] = None,
+    ) -> None:
+        if snap is None:
+            snap = await self.job_store.watch_meta(req.job_id)
+        # fields produced along the way (config hash, attempts) ride the next
+        # state-transition commit instead of costing their own round trips
+        pending_fields: dict[str, str] = {}
+        cfg_hash = await self._attach_effective_config(req)
+        if cfg_hash:
+            pending_fields["config_hash"] = cfg_hash
 
         async with self.tracer.span(
             "policy-check", attrs={"job_id": req.job_id}
@@ -210,23 +254,29 @@ class Engine:
             resp = await self._check_safety(req)
             polsp.attrs["decision"] = resp.decision
         decision = resp.decision
+        decision_ops = self.job_store.put_safety_decision_ops(
+            self._decision_record(req, resp)
+        )
 
         if decision == Decision.DENY.value:
             self.metrics.jobs_denied.inc(topic=req.topic)
-            await self.job_store.put_safety_decision(self._decision_record(req, resp))
-            await self.job_store.set_state(
-                req.job_id, JobState.DENIED, fields={"deny_reason": resp.reason}, event="safety_deny"
+            await self.job_store.apply_chain(
+                req.job_id,
+                [(JobState.DENIED,
+                  {"deny_reason": resp.reason, **pending_fields}, "safety_deny")],
+                snap=snap, extra_ops=decision_ops,
             )
             await self._emit_dlq(req, resp.reason, "SAFETY_DENY", status=JobState.DENIED.value)
             return
 
         if decision == Decision.REQUIRE_APPROVAL.value:
-            await self.job_store.put_safety_decision(self._decision_record(req, resp))
-            await self.job_store.set_state(
+            await self.job_store.apply_chain(
                 req.job_id,
-                JobState.APPROVAL_REQUIRED,
-                fields={"approval_reason": resp.reason, "policy_snapshot": resp.policy_snapshot},
-                event="approval_required",
+                [(JobState.APPROVAL_REQUIRED,
+                  {"approval_reason": resp.reason,
+                   "policy_snapshot": resp.policy_snapshot, **pending_fields},
+                  "approval_required")],
+                snap=snap, extra_ops=decision_ops,
             )
             return  # parked until an admin approves
 
@@ -234,10 +284,11 @@ class Engine:
             delay = resp.throttle_delay_s or 1.0
             raise RetryAfter(delay, f"throttled: {resp.reason}")
 
-        # Record the decision with the hash of the request *as approved/checked*,
-        # before constraint injection mutates env (otherwise the stored hash
-        # would never match a faithful republish).
-        await self.job_store.put_safety_decision(self._decision_record(req, resp))
+        # The decision record carries the hash of the request *as
+        # approved/checked*, before constraint injection mutates env
+        # (otherwise the stored hash would never match a faithful
+        # republish); the write itself rides the SCHEDULED commit.
+        extra_ops = list(decision_ops)
         if decision == Decision.ALLOW_WITH_CONSTRAINTS.value and resp.constraints:
             self._apply_constraints(req, resp.constraints)
 
@@ -256,19 +307,24 @@ class Engine:
             if active >= limit:
                 raise RetryAfter(0.25, f"tenant {req.tenant_id} at concurrency limit {limit}")
         if req.tenant_id:
-            await self.job_store.tenant_active_add(req.tenant_id, req.job_id)
+            extra_ops += self.job_store.tenant_active_add_ops(req.tenant_id, req.job_id)
 
         # deadline registration
         if req.budget and req.budget.deadline_unix_ms:
-            await self.job_store.register_deadline(req.job_id, req.budget.deadline_unix_ms)
+            extra_ops += self.job_store.register_deadline_ops(
+                req.job_id, req.budget.deadline_unix_ms
+            )
 
         # dispatch-attempts guard: counted only for real dispatch attempts so
         # backpressure redeliveries (throttle / tenant concurrency) don't burn
         # the budget of a job that merely waited
-        attempts = int(meta.get("attempts", "0")) + 1
-        await self.job_store.set_fields(req.job_id, {"attempts": str(attempts)})
+        attempts = int(snap.get("attempts", "0") or "0") + 1
+        pending_fields["attempts"] = str(attempts)
         if attempts > self.max_attempts:
-            await self._fail_to_dlq(req, "max attempts exceeded", "MAX_RETRIES")
+            await self._fail_to_dlq(
+                req, "max attempts exceeded", "MAX_RETRIES",
+                fields=pending_fields, snap=snap,
+            )
             return
 
         # pick subject and dispatch
@@ -278,18 +334,40 @@ class Engine:
         async with self.tracer.span(
             "dispatch", attrs={"job_id": req.job_id, "target": target}
         ) as dsp:
-            await self.job_store.set_state(
-                req.job_id, JobState.SCHEDULED, fields={"dispatch_subject": target}, event="scheduled"
+            # ONE pipelined commit: →SCHEDULED + decision record + tenant
+            # membership + deadline + attempts/config fields (was 6-9
+            # round trips of separate writes)
+            _, snap = await self.job_store.apply_chain(
+                req.job_id,
+                [(JobState.SCHEDULED,
+                  {"dispatch_subject": target, **pending_fields}, "scheduled")],
+                snap=snap, extra_ops=extra_ops,
             )
             out = BusPacket.wrap(
                 req, trace_id=trace_id, sender_id=self.instance_id,
                 span_id=dsp.span_id, parent_span_id=dsp.parent_span_id,
             )
-            await self.bus.publish(target, out)
-            await self.job_store.set_state(req.job_id, JobState.DISPATCHED, event="dispatched")
-            await self.job_store.set_state(req.job_id, JobState.RUNNING, event="running")
+            # Overlap the load-bearing dispatch publish with the
+            # non-load-bearing DISPATCHED→RUNNING bookkeeping commit (one
+            # pipelined chain).  If the publish fails the chain may still
+            # land, leaving the job RUNNING-but-undelivered; the
+            # reconciler's running-timeout recovers it, and the publish
+            # error still propagates for bus-level redelivery.
+            results = await asyncio.gather(
+                self.bus.publish(target, out),
+                self.job_store.apply_chain(
+                    req.job_id,
+                    [(JobState.DISPATCHED, None, "dispatched"),
+                     (JobState.RUNNING, None, "running")],
+                    snap=snap,
+                ),
+                return_exceptions=True,
+            )
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
         self.metrics.jobs_dispatched.inc(topic=req.topic)
-        sub_us = int(meta.get("submitted_at_us", "0") or 0)
+        sub_us = int(snap.get("submitted_at_us", "0") or 0)
         if sub_us:
             self.metrics.dispatch_latency.observe(max(0.0, (now_us() - sub_us) / 1e6))
 
@@ -304,30 +382,43 @@ class Engine:
         if not await self.job_store.acquire_job_lock(job_id, self.instance_id, ttl_s=30.0):
             return False
         try:
-            if await self.job_store.get_state(job_id) != JobState.SCHEDULED.value:
+            snap = await self.job_store.watch_meta(job_id)
+            if snap.state != JobState.SCHEDULED.value:
                 return False  # moved on concurrently
             req = await self.job_store.get_request(job_id)
             if req is None:
                 return False
-            meta = await self.job_store.get_meta(job_id)
-            attempts = int(meta.get("attempts", "0")) + 1
-            await self.job_store.set_fields(job_id, {"attempts": str(attempts)})
+            attempts = int(snap.get("attempts", "0") or "0") + 1
             if attempts > self.max_attempts:
-                await self._fail_to_dlq(req, "max attempts exceeded", "MAX_RETRIES")
+                await self._fail_to_dlq(
+                    req, "max attempts exceeded", "MAX_RETRIES",
+                    fields={"attempts": str(attempts)}, snap=snap,
+                )
                 return True
             target = self.strategy.pick_subject(req)
+            # attempts must land BEFORE the publish: a persistently failing
+            # publish still burns its budget and reaches the DLQ instead of
+            # looping forever (idempotent fields-only commit keeps the
+            # snapshot current for the chain below)
+            _, snap = await self.job_store.apply_chain(
+                job_id,
+                [(JobState.SCHEDULED, {"attempts": str(attempts)}, "")],
+                snap=snap,
+            )
             # fresh bus msg-id label: the redispatch must survive the dedupe
             # window even if the original publish reached the bus
             req.labels = dict(req.labels or {})
             req.labels["cordum.bus_msg_id"] = f"redispatch-{job_id}-{attempts}"
-            out = BusPacket.wrap(req, trace_id=meta.get("trace_id", ""),
+            out = BusPacket.wrap(req, trace_id=snap.get("trace_id", ""),
                                  sender_id=self.instance_id)
             await self.bus.publish(target, out)
-            await self.job_store.set_state(
-                job_id, JobState.DISPATCHED,
-                fields={"dispatch_subject": target}, event="redispatched",
+            await self.job_store.apply_chain(
+                job_id,
+                [(JobState.DISPATCHED,
+                  {"dispatch_subject": target}, "redispatched"),
+                 (JobState.RUNNING, None, "running")],
+                snap=snap,
             )
-            await self.job_store.set_state(job_id, JobState.RUNNING, event="running")
             self.metrics.jobs_dispatched.inc(topic=req.topic)
             return True
         finally:
@@ -404,29 +495,36 @@ class Engine:
         ):
             req.budget.max_cost_usd = c.max_cost_usd
 
-    async def _attach_effective_config(self, req: JobRequest) -> None:
+    async def _attach_effective_config(self, req: JobRequest) -> str:
+        """Injects the effective config into the request env and returns its
+        hash; the caller folds the ``config_hash`` meta field into the next
+        state-transition commit (no separate write round trip)."""
         if self.configsvc is None:
-            return
+            return ""
         snap = await self.configsvc.effective_snapshot(
             org=req.tenant_id, workflow=req.workflow_id
         )
         req.env = dict(req.env or {})
         req.env[ENV_EFFECTIVE_CONFIG] = snap["config"]
-        await self.job_store.set_fields(req.job_id, {"config_hash": snap["hash"]})
+        return str(snap["hash"])
 
     # ------------------------------------------------------------------
     async def _on_result(self, subject: str, pkt: BusPacket) -> None:
         res = pkt.job_result
         if res is None or not res.job_id:
             return
-        await self.handle_job_result(
-            res, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
-        )
+        async with self._sem:
+            await self.handle_job_result(
+                res, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
+            )
 
     async def handle_job_result(
         self, res: JobResult, *, trace_id: str = "", parent_span_id: str = ""
     ) -> None:
-        if await self.job_store.is_terminal(res.job_id):
+        # one snapshot read serves the terminal short-circuit, the
+        # transition's optimistic first attempt, AND the e2e-latency meta
+        snap = await self.job_store.watch_meta(res.job_id)
+        if snap.state and snap.is_terminal:
             return  # already terminal: redelivery no-op
         try:
             state = JobState(res.status)
@@ -442,9 +540,11 @@ class Engine:
             parent_span_id=parent_span_id,
             attrs={"job_id": res.job_id, "status": state.value},
         ):
-            await self._apply_terminal_result(res, state)
+            await self._apply_terminal_result(res, state, snap)
 
-    async def _apply_terminal_result(self, res: JobResult, state: JobState) -> None:
+    async def _apply_terminal_result(
+        self, res: JobResult, state: JobState, snap: Optional[MetaSnapshot] = None
+    ) -> None:
         fields = {
             "result_ptr": res.result_ptr,
             "worker_id": res.worker_id,
@@ -453,11 +553,14 @@ class Engine:
         if res.error_message:
             fields["error_message"] = res.error_message
             fields["error_code"] = res.error_code
-        await self.job_store.set_state(res.job_id, state, fields=fields, event="result")
-        await self.job_store.clear_deadline(res.job_id)
+        # one pipelined commit: terminal transition + result fields + event
+        # (+ deadline clear + tenant-active removal, folded in by the
+        # transition builder for terminal states)
+        _, snap = await self.job_store.apply_chain(
+            res.job_id, [(state, fields, "result")], snap=snap
+        )
         self.metrics.jobs_completed.inc(status=state.value)
-        meta = await self.job_store.get_meta(res.job_id)
-        sub_us = int(meta.get("submitted_at_us", "0") or 0)
+        sub_us = int(snap.get("submitted_at_us", "0") or 0)
         if sub_us:
             self.metrics.e2e_latency.observe(max(0.0, (now_us() - sub_us) / 1e6))
         if state in (JobState.FAILED, JobState.TIMEOUT):
@@ -471,10 +574,15 @@ class Engine:
                 )
 
     # ------------------------------------------------------------------
-    async def _fail_to_dlq(self, req: JobRequest, reason: str, code: str) -> None:
+    async def _fail_to_dlq(
+        self, req: JobRequest, reason: str, code: str, *,
+        fields: Optional[dict[str, str]] = None,
+        snap: Optional[MetaSnapshot] = None,
+    ) -> None:
         try:
+            f = {"error_message": reason, **(fields or {})}
             await self.job_store.set_state(
-                req.job_id, JobState.FAILED, fields={"error_message": reason}, event="dlq"
+                req.job_id, JobState.FAILED, fields=f, event="dlq", snap=snap
             )
         except Exception as e:  # noqa: BLE001 - job may already be terminal
             logx.warn("could not mark job FAILED before DLQ", job_id=req.job_id, err=str(e))

@@ -38,6 +38,7 @@ Handler = Callable[[str, BusPacket], Awaitable[None]]
 
 DEDUP_WINDOW_S = 120.0  # JetStream 2m dedup window equivalent
 MAX_REDELIVERIES = 5
+MAX_NAK_DELAY_S = 30.0  # cap on a single RetryAfter backoff sleep
 
 
 class RetryAfter(Exception):
@@ -202,20 +203,27 @@ class LoopbackBus(Bus):
                 self._tasks.add(t)
                 t.add_done_callback(self._tasks.discard)
 
-    async def _deliver(
-        self, sub: _Subscription, subject: str, pkt: BusPacket, attempt: int = 1
-    ) -> None:
-        try:
-            await sub.handler(subject, pkt)
-        except RetryAfter as ra:
-            durable = self._durable and subj.is_durable_subject(subject)
-            if not durable or attempt >= MAX_REDELIVERIES or sub.closed or self._closed:
-                log.warning("dropping message on %s after %d attempts", subject, attempt)
+    async def _deliver(self, sub: _Subscription, subject: str, pkt: BusPacket) -> None:
+        # Iterative redelivery loop: the old recursive form grew one stack
+        # frame per NAK, so a hot RetryAfter cycle (delay≈0) walked toward
+        # the recursion limit across MAX_REDELIVERIES; the requested delay
+        # is additionally capped so a handler can't park the delivery task
+        # arbitrarily long.
+        attempt = 1
+        while True:
+            try:
+                await sub.handler(subject, pkt)
                 return
-            await asyncio.sleep(ra.delay_s)
-            await self._deliver(sub, subject, pkt, attempt + 1)
-        except Exception:
-            log.exception("handler error on %s (acked; no redelivery)", subject)
+            except RetryAfter as ra:
+                durable = self._durable and subj.is_durable_subject(subject)
+                if not durable or attempt >= MAX_REDELIVERIES or sub.closed or self._closed:
+                    log.warning("dropping message on %s after %d attempts", subject, attempt)
+                    return
+                attempt += 1
+                await asyncio.sleep(min(max(ra.delay_s, 0.0), MAX_NAK_DELAY_S))
+            except Exception:
+                log.exception("handler error on %s (acked; no redelivery)", subject)
+                return
 
     async def drain(self) -> None:
         """Wait for all in-flight async deliveries (tests)."""

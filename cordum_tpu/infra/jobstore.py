@@ -35,6 +35,8 @@ DEFAULT_META_TTL_S = 7 * 24 * 3600.0
 RECENT_CAP = 10_000
 EVENTS_CAP = 200
 
+_TERMINAL_VALUES = frozenset(s.value for s in TERMINAL_STATES)
+
 
 class IllegalTransition(Exception):
     def __init__(self, job_id: str, prev: str, nxt: str) -> None:
@@ -91,6 +93,42 @@ class ApprovalRecord:
     decided_at_us: int = 0
 
 
+@dataclass
+class MetaSnapshot:
+    """Optimistic view of one job's ``job:meta`` hash: ``(version, fields)``.
+
+    Returned by :meth:`JobStore.watch_meta` and threaded through
+    :meth:`JobStore.apply_chain`, which refreshes it locally from the
+    pipeline's post-commit version — so a sequence of transitions on one
+    job needs exactly one read round trip (or zero, for the optimistic
+    fresh-job path that starts from ``MetaSnapshot()`` = "key absent").
+    """
+
+    version: int = 0
+    fields: dict[str, bytes] = field(default_factory=dict)
+
+    @property
+    def state(self) -> str:
+        v = self.fields.get("state")
+        return v.decode() if v else ""
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.state in _TERMINAL_VALUES
+
+    def get(self, key: str, default: str = "") -> str:
+        v = self.fields.get(key)
+        return v.decode() if v else default
+
+    def decoded(self) -> dict[str, str]:
+        return {k: v.decode() for k, v in self.fields.items()}
+
+
+# One validated state transition inside an apply_chain() call:
+# (state, fields-or-None, event-name)
+Transition = tuple[JobState, Optional[dict[str, str]], str]
+
+
 class JobStore:
     def __init__(self, kv: KV, *, meta_ttl_s: float = DEFAULT_META_TTL_S) -> None:
         self.kv = kv
@@ -107,26 +145,35 @@ class JobStore:
         h = await self.kv.hgetall(meta_key(job_id))
         return {k: v.decode() for k, v in h.items()}
 
-    async def set_state(
-        self,
-        job_id: str,
-        state: JobState,
-        *,
-        fields: Optional[dict[str, str]] = None,
-        event: str = "",
-        max_retries: int = 16,
-    ) -> bool:
-        """Atomic validated transition.  Returns True if the state changed,
-        False if the job is already in ``state`` (idempotent re-apply).
-        Raises :class:`IllegalTransition` otherwise."""
+    async def watch_meta(self, job_id: str) -> MetaSnapshot:
+        """One-round-trip ``(version, hash)`` snapshot of ``job:meta``."""
+        ver, h = await self.kv.watch_read(meta_key(job_id))
+        return MetaSnapshot(ver, h)
+
+    def _chain_ops(
+        self, job_id: str, snap: MetaSnapshot, steps: list[Transition]
+    ) -> tuple[list[tuple], dict[str, bytes], bool]:
+        """Build the pipelined op list for a chain of validated transitions
+        applied on top of ``snap``.  Returns ``(ops, overlay, changed)``
+        where ``overlay`` is the field delta for refreshing the snapshot
+        locally after a successful commit.  Raises
+        :class:`IllegalTransition` on the first invalid step."""
         key = meta_key(job_id)
-        for _ in range(max_retries):
-            ver, h = await self.kv.watch_read(key)
-            prev = h.get("state", b"").decode()
+        ops: list[tuple] = []
+        overlay: dict[str, bytes] = {}
+        cur = dict(snap.fields)
+        prev = (cur.get("state") or b"").decode()
+        exists = bool(cur)
+        changed = False
+        for state, fields, event in steps:
             if prev == state.value:
+                # idempotent re-apply: update fields only, no transition ops
                 if fields:
-                    await self.kv.hset(key, {k: v.encode() for k, v in fields.items()})
-                return False
+                    m = {k: v.encode() for k, v in fields.items()}
+                    ops.append(("hset", key, m))
+                    overlay.update(m)
+                    cur.update(m)
+                continue
             if not is_allowed_transition(prev, state):
                 raise IllegalTransition(job_id, prev, state.value)
             ts = now_us()
@@ -134,13 +181,13 @@ class JobStore:
                 "state": state.value.encode(),
                 "updated_at_us": str(ts).encode(),
             }
-            if not h:
+            if not exists:
                 mapping["created_at_us"] = str(ts).encode()
             if state in TERMINAL_STATES:
                 mapping["finished_at_us"] = str(ts).encode()
             for k, v in (fields or {}).items():
                 mapping[k] = v.encode()
-            ops: list[tuple] = [("hset", key, mapping)]
+            ops.append(("hset", key, mapping))
             if prev:
                 ops.append(("zrem", index_key(prev), job_id))
             ops.append(("zadd", index_key(state.value), job_id, float(ts)))
@@ -152,23 +199,96 @@ class JobStore:
                 "event": event or f"state:{state.value}",
             }
             ops.append(("rpush", events_key(job_id), json.dumps(ev).encode()))
-            ops.append(("expire", key, self.meta_ttl_s))
             if state in TERMINAL_STATES:
                 ops.append(("zrem", DEADLINE_KEY, job_id))
-                tenant = h.get("tenant_id", b"").decode()
-                if tenant and prev and prev not in (s.value for s in TERMINAL_STATES):
+                tenant = (cur.get("tenant_id") or b"").decode()
+                if tenant and prev and prev not in _TERMINAL_VALUES:
                     ops.append(("zrem", f"job:tenant_active:{tenant}", job_id))
-            if await self.kv.commit({key: ver}, ops):
-                return True
-        raise RuntimeError(f"job {job_id}: transition to {state.value} lost race repeatedly")
+            overlay.update(mapping)
+            cur.update(mapping)
+            prev = state.value
+            exists = True
+            changed = True
+        if changed:
+            ops.append(("ltrim", events_key(job_id), -EVENTS_CAP, -1))
+            ops.append(("expire", key, self.meta_ttl_s))
+        return ops, overlay, changed
+
+    async def apply_chain(
+        self,
+        job_id: str,
+        steps: list[Transition],
+        *,
+        snap: Optional[MetaSnapshot] = None,
+        extra_ops: Optional[list[tuple]] = None,
+        max_retries: int = 16,
+    ) -> tuple[Optional[bool], MetaSnapshot]:
+        """Apply a chain of validated transitions (plus any ``extra_ops``
+        record writes) as ONE pipelined, version-checked commit.
+
+        ``snap`` (from :meth:`watch_meta`, a previous ``apply_chain``, or
+        ``MetaSnapshot()`` for the optimistic "job does not exist yet" fast
+        path) makes the first attempt read-free; a conflict re-reads and
+        retries.  Returns ``(changed, snap)``: ``True`` if any step moved
+        the state, ``False`` if every step was an idempotent re-apply, and
+        ``None`` when ``max_retries`` attempts all lost the race (the
+        returned snapshot is then a fresh read the caller can inspect).
+        Raises :class:`IllegalTransition` on an invalid step."""
+        key = meta_key(job_id)
+        for attempt in range(max_retries):
+            if snap is None:
+                snap = await self.watch_meta(job_id)
+            ops, overlay, changed = self._chain_ops(job_id, snap, steps)
+            if extra_ops:
+                ops = [*ops, *extra_ops]
+            if not ops:
+                return False, snap
+            pipe = self.kv.pipeline().extend(ops)
+            pipe.watch(key, snap.version)
+            if await pipe.execute():
+                merged = dict(snap.fields)
+                merged.update(overlay)
+                return changed, MetaSnapshot(pipe.new_versions.get(key, 0), merged)
+            snap = None  # lost the race: re-read on the next attempt
+        return None, await self.watch_meta(job_id)
+
+    async def set_state(
+        self,
+        job_id: str,
+        state: JobState,
+        *,
+        fields: Optional[dict[str, str]] = None,
+        event: str = "",
+        max_retries: int = 16,
+        snap: Optional[MetaSnapshot] = None,
+        extra_ops: Optional[list[tuple]] = None,
+    ) -> bool:
+        """Atomic validated transition.  Returns True if the state changed,
+        False if the job is already in ``state`` (idempotent re-apply).
+        Raises :class:`IllegalTransition` otherwise."""
+        changed, _ = await self.apply_chain(
+            job_id, [(state, fields, event)],
+            snap=snap, extra_ops=extra_ops, max_retries=max_retries,
+        )
+        if changed is None:
+            raise RuntimeError(
+                f"job {job_id}: transition to {state.value} lost race repeatedly"
+            )
+        return changed
+
+    def set_fields_ops(self, job_id: str, fields: dict[str, str]) -> list[tuple]:
+        return [
+            ("hset", meta_key(job_id), {k: v.encode() for k, v in fields.items()}),
+            ("expire", meta_key(job_id), self.meta_ttl_s),
+        ]
 
     async def set_fields(self, job_id: str, fields: dict[str, str]) -> None:
-        await self.kv.hset(meta_key(job_id), {k: v.encode() for k, v in fields.items()})
-        await self.kv.expire(meta_key(job_id), self.meta_ttl_s)
+        pipe = self.kv.pipeline().extend(self.set_fields_ops(job_id, fields))
+        await pipe.execute()
 
     async def is_terminal(self, job_id: str) -> bool:
         st = await self.get_state(job_id)
-        return bool(st) and st in (s.value for s in TERMINAL_STATES)
+        return bool(st) and st in _TERMINAL_VALUES
 
     # ------------------------------------------------------------------
     # indexes / listing
@@ -188,6 +308,9 @@ class JobStore:
     # ------------------------------------------------------------------
     # deadlines
     # ------------------------------------------------------------------
+    def register_deadline_ops(self, job_id: str, deadline_unix_ms: int) -> list[tuple]:
+        return [("zadd", DEADLINE_KEY, job_id, float(deadline_unix_ms))]
+
     async def register_deadline(self, job_id: str, deadline_unix_ms: int) -> None:
         await self.kv.zadd(DEADLINE_KEY, job_id, float(deadline_unix_ms))
 
@@ -202,11 +325,16 @@ class JobStore:
     # ------------------------------------------------------------------
     async def append_event(self, job_id: str, event: str, **kw: Any) -> None:
         ev = {"ts_us": now_us(), "event": event, **kw}
-        await self.kv.rpush(events_key(job_id), json.dumps(ev).encode())
-        await self.kv.ltrim(events_key(job_id), -EVENTS_CAP, -1)
+        pipe = self.kv.pipeline()
+        pipe.rpush(events_key(job_id), json.dumps(ev).encode())
+        pipe.ltrim(events_key(job_id), -EVENTS_CAP, -1)
+        await pipe.execute()
 
     async def events(self, job_id: str) -> list[dict]:
         return [json.loads(b) for b in await self.kv.lrange(events_key(job_id))]
+
+    def add_to_trace_ops(self, trace_id: str, job_id: str) -> list[tuple]:
+        return [("sadd", trace_key(trace_id), job_id)] if trace_id else []
 
     async def add_to_trace(self, trace_id: str, job_id: str) -> None:
         if trace_id:
@@ -218,6 +346,9 @@ class JobStore:
     # ------------------------------------------------------------------
     # tenant concurrency
     # ------------------------------------------------------------------
+    def tenant_active_add_ops(self, tenant_id: str, job_id: str) -> list[tuple]:
+        return [("zadd", f"job:tenant_active:{tenant_id}", job_id, float(now_us()))]
+
     async def tenant_active_add(self, tenant_id: str, job_id: str) -> int:
         key = f"job:tenant_active:{tenant_id}"
         await self.kv.zadd(key, job_id, float(now_us()))
@@ -247,13 +378,17 @@ class JobStore:
         return await self.kv.setnx(f"lock:job:{job_id}", owner.encode(), ttl_s)
 
     async def release_job_lock(self, job_id: str, owner: str) -> None:
-        cur = await self.kv.get(f"lock:job:{job_id}")
-        if cur is not None and cur.decode() == owner:
-            await self.kv.delete(f"lock:job:{job_id}")
+        # atomic compare-and-delete: one round trip, and no window where a
+        # TTL-expired-and-reacquired lock could be deleted out from under
+        # its new owner between the read and the delete
+        await self.kv.del_eq(f"lock:job:{job_id}", owner.encode())
 
     # ------------------------------------------------------------------
     # persisted requests (for replays + approvals)
     # ------------------------------------------------------------------
+    def put_request_ops(self, req: JobRequest) -> list[tuple]:
+        return [("set", request_key(req.job_id), req.to_wire(), self.meta_ttl_s)]
+
     async def put_request(self, req: JobRequest) -> None:
         await self.kv.set(request_key(req.job_id), req.to_wire(), self.meta_ttl_s)
 
@@ -264,6 +399,13 @@ class JobStore:
     # ------------------------------------------------------------------
     # safety decisions + approvals
     # ------------------------------------------------------------------
+    def put_safety_decision_ops(self, rec: SafetyDecisionRecord) -> list[tuple]:
+        rec.decided_at_us = rec.decided_at_us or now_us()
+        return [(
+            "set", f"job:safety:{rec.job_id}",
+            json.dumps(rec.__dict__).encode(), self.meta_ttl_s,
+        )]
+
     async def put_safety_decision(self, rec: SafetyDecisionRecord) -> None:
         rec.decided_at_us = rec.decided_at_us or now_us()
         await self.kv.set(
@@ -287,11 +429,11 @@ class JobStore:
     # ------------------------------------------------------------------
     async def cancel_job(self, job_id: str) -> bool:
         """Move a non-terminal job to CANCELLED; False if terminal/unknown."""
-        st = await self.get_state(job_id)
-        if not st or st in (s.value for s in TERMINAL_STATES):
+        snap = await self.watch_meta(job_id)
+        if not snap.state or snap.is_terminal:
             return False
         try:
-            await self.set_state(job_id, JobState.CANCELLED, event="cancel")
+            await self.set_state(job_id, JobState.CANCELLED, event="cancel", snap=snap)
             return True
         except IllegalTransition:
             return False

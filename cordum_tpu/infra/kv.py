@@ -40,8 +40,119 @@ class TxnConflict(Exception):
     """Optimistic transaction lost the race; caller retries."""
 
 
+# Mutating ops a Pipeline may buffer (superset of the old commit() op table).
+PIPELINE_OPS = (
+    "set", "delete", "hset", "hdel", "zadd", "zrem", "rpush", "ltrim",
+    "sadd", "expire", "del_eq",
+)
+
+
+class Pipeline:
+    """Buffered multi-op batch with optional version watches.
+
+    Ops are queued client-side and applied in ONE backend round trip:
+    ``MemoryKV`` executes the whole batch inside a single lock acquisition;
+    ``StateBusKV`` ships it as a single ``PIPE`` wire frame that the server
+    applies atomically (the Redis MULTI/EXEC + pipelining equivalent the
+    reference job store leans on for its hot path).
+
+    ``watch(key, version)`` turns the batch into an optimistic transaction:
+    it applies iff every watched key still carries the given version
+    (version 0 = key absent).  ``execute()`` returns True on success and
+    False on conflict; after a successful execute, ``new_versions`` maps
+    each watched key to its post-commit version so chained transactions on
+    the same key need no re-read round trip.
+
+    Ops are validated (by name) before anything is applied — an unknown op
+    rejects the WHOLE batch with ``ValueError`` and leaves state untouched.
+    """
+
+    __slots__ = ("_kv", "_watches", "_ops", "new_versions")
+
+    def __init__(self, kv: "KV") -> None:
+        self._kv = kv
+        self._watches: dict[str, int] = {}
+        self._ops: list[tuple] = []
+        self.new_versions: dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._ops)
+
+    @property
+    def ops(self) -> list[tuple]:
+        return list(self._ops)
+
+    def watch(self, key: str, version: int) -> "Pipeline":
+        self._watches[key] = version
+        return self
+
+    def op(self, name: str, *args: Any) -> "Pipeline":
+        if name not in PIPELINE_OPS:
+            raise ValueError(f"op {name!r} is not pipelineable")
+        self._ops.append((name, *args))
+        return self
+
+    def extend(self, ops: Iterable[tuple]) -> "Pipeline":
+        for o in ops:
+            self.op(*o)
+        return self
+
+    # buffered op builders ------------------------------------------------
+    def set(self, key: str, value: bytes, ttl_s: Optional[float] = None) -> "Pipeline":
+        return self.op("set", key, value, ttl_s)
+
+    def delete(self, *keys: str) -> "Pipeline":
+        return self.op("delete", *keys)
+
+    def del_eq(self, key: str, expect: bytes) -> "Pipeline":
+        return self.op("del_eq", key, expect)
+
+    def hset(self, key: str, mapping: dict[str, bytes]) -> "Pipeline":
+        return self.op("hset", key, mapping)
+
+    def hdel(self, key: str, *fields: str) -> "Pipeline":
+        return self.op("hdel", key, *fields)
+
+    def zadd(self, key: str, member: str, score: float) -> "Pipeline":
+        return self.op("zadd", key, member, score)
+
+    def zrem(self, key: str, *members: str) -> "Pipeline":
+        return self.op("zrem", key, *members)
+
+    def rpush(self, key: str, *values: bytes) -> "Pipeline":
+        return self.op("rpush", key, *values)
+
+    def ltrim(self, key: str, start: int, stop: int) -> "Pipeline":
+        return self.op("ltrim", key, start, stop)
+
+    def sadd(self, key: str, *members: str) -> "Pipeline":
+        return self.op("sadd", key, *members)
+
+    def expire(self, key: str, ttl_s: float) -> "Pipeline":
+        return self.op("expire", key, ttl_s)
+
+    async def execute(self) -> bool:
+        ok, versions = await self._kv.pipe_execute(dict(self._watches), list(self._ops))
+        self.new_versions = versions
+        return ok
+
+
 class KV:
     """Async key-value interface.  Values are bytes; hashes map str->bytes."""
+
+    #: bound by services that want `cordum_kv_roundtrips_total{op}` /
+    #: `cordum_kv_pipeline_size` emitted (see infra/metrics.py)
+    metrics: Any = None
+
+    def bind_metrics(self, metrics: Any) -> None:
+        self.metrics = metrics
+
+    def _observe_op(self, op: str, pipeline_size: int = 0) -> None:
+        m = self.metrics
+        if m is not None:
+            m.kv_roundtrips.inc(op=op)
+            if pipeline_size:
+                m.kv_pipeline_size.observe(float(pipeline_size))
 
     # strings -------------------------------------------------------------
     async def get(self, key: str) -> Optional[bytes]:
@@ -54,6 +165,12 @@ class KV:
         raise NotImplementedError
 
     async def delete(self, *keys: str) -> int:
+        raise NotImplementedError
+
+    async def del_eq(self, key: str, expect: bytes) -> bool:
+        """Delete ``key`` iff its current value equals ``expect`` (atomic
+        compare-and-delete — the owner-checked lock release in one round
+        trip instead of get+delete)."""
         raise NotImplementedError
 
     async def expire(self, key: str, ttl_s: float) -> bool:
@@ -142,6 +259,19 @@ class KV:
         conflict (the WATCH-abort equivalent)."""
         raise NotImplementedError
 
+    # pipelining ----------------------------------------------------------
+    def pipeline(self) -> Pipeline:
+        """Start a buffered multi-op batch (see :class:`Pipeline`)."""
+        return Pipeline(self)
+
+    async def pipe_execute(
+        self, watches: dict[str, int], ops: list[tuple]
+    ) -> tuple[bool, dict[str, int]]:
+        """Apply a pipeline batch in one round trip.  Returns ``(ok,
+        new_versions)`` where ``new_versions`` maps each watched key to its
+        post-commit version (chained optimistic transactions read-free)."""
+        raise NotImplementedError
+
     async def ping(self) -> bool:
         return True
 
@@ -221,6 +351,10 @@ class MemoryKV(KV):
         async with self._lock:
             return self._delete_op(*keys)
 
+    async def del_eq(self, key: str, expect: bytes) -> bool:
+        async with self._lock:
+            return bool(self._del_eq_op(key, expect))
+
     async def expire(self, key: str, ttl_s: float) -> bool:
         async with self._lock:
             e = self._live(key)
@@ -254,17 +388,7 @@ class MemoryKV(KV):
 
     async def hdel(self, key: str, *fields: str) -> int:
         async with self._lock:
-            e = self._live(key)
-            if e is None or not isinstance(e.value, dict):
-                return 0
-            n = 0
-            for f in fields:
-                if f in e.value:
-                    del e.value[f]
-                    n += 1
-            if n:
-                self._touch(e)
-            return n
+            return self._hdel_op(key, *fields)
 
     async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
         async with self._lock:
@@ -337,12 +461,7 @@ class MemoryKV(KV):
 
     async def ltrim(self, key: str, start: int, stop: int) -> None:
         async with self._lock:
-            e = self._live(key)
-            if e is None or not isinstance(e.value, list):
-                return
-            lst = e.value
-            e.value = lst[start:] if stop == -1 else lst[start : stop + 1]
-            self._touch(e)
+            self._ltrim_op(key, start, stop)
 
     async def llen(self, key: str) -> int:
         async with self._lock:
@@ -352,11 +471,7 @@ class MemoryKV(KV):
     # sets ----------------------------------------------------------------
     async def sadd(self, key: str, *members: str) -> int:
         async with self._lock:
-            e = self._container(key, set)
-            before = len(e.value)
-            e.value.update(members)
-            self._touch(e)
-            return len(e.value) - before
+            return self._sadd_op(key, *members)
 
     async def smembers(self, key: str) -> set[str]:
         async with self._lock:
@@ -388,6 +503,41 @@ class MemoryKV(KV):
                 del self._data[k]
                 n += 1
         return n
+
+    def _del_eq_op(self, key: str, expect: bytes) -> int:
+        e = self._live(key)
+        if e is not None and e.value == expect:
+            del self._data[key]
+            return 1
+        return 0
+
+    def _hdel_op(self, key: str, *fields: str) -> int:
+        e = self._live(key)
+        if e is None or not isinstance(e.value, dict):
+            return 0
+        n = 0
+        for f in fields:
+            if f in e.value:
+                del e.value[f]
+                n += 1
+        if n:
+            self._touch(e)
+        return n
+
+    def _ltrim_op(self, key: str, start: int, stop: int) -> None:
+        e = self._live(key)
+        if e is None or not isinstance(e.value, list):
+            return
+        lst = e.value
+        e.value = lst[start:] if stop == -1 else lst[start : stop + 1]
+        self._touch(e)
+
+    def _sadd_op(self, key: str, *members: str) -> int:
+        e = self._container(key, set)
+        before = len(e.value)
+        e.value.update(members)
+        self._touch(e)
+        return len(e.value) - before
 
     def _hset_op(self, key: str, mapping: dict[str, bytes]) -> None:
         e = self._container(key, dict)
@@ -426,21 +576,80 @@ class MemoryKV(KV):
     _OPS = {
         "set": "_set_op",
         "delete": "_delete_op",
+        "del_eq": "_del_eq_op",
         "hset": "_hset_op",
+        "hdel": "_hdel_op",
         "zadd": "_zadd_op",
         "zrem": "_zrem_op",
         "rpush": "_rpush_op",
+        "ltrim": "_ltrim_op",
+        "sadd": "_sadd_op",
         "expire": "_expire_op",
     }
 
+    def _pipe_locked(
+        self, watches: dict[str, int], ops: list[tuple]
+    ) -> tuple[bool, dict[str, int]]:
+        """Caller holds the lock.  Validates op names BEFORE applying so an
+        unknown op rejects the whole batch (never a partial application),
+        then checks watches and applies.  Returns post-commit versions of
+        the watched keys."""
+        appliers = []
+        for op in ops:
+            name = op[0]
+            applier = self._OPS.get(name)
+            if applier is None:
+                raise ValueError(f"unknown pipeline op {name!r}")
+            appliers.append((applier, op[1:]))
+        for key, ver in watches.items():
+            e = self._live(key)
+            cur = e.version if e is not None else 0
+            if cur != ver:
+                return False, {}
+        for applier, args in appliers:
+            getattr(self, applier)(*args)
+        versions: dict[str, int] = {}
+        for key in watches:
+            e = self._live(key)
+            versions[key] = e.version if e is not None else 0
+        return True, versions
+
     async def commit(self, watches: dict[str, int], ops: list[tuple]) -> bool:
         async with self._lock:
-            for key, ver in watches.items():
-                e = self._live(key)
-                cur = e.version if e is not None else 0
-                if cur != ver:
-                    return False
-            for op in ops:
-                name, *args = op
-                getattr(self, self._OPS[name])(*args)
-            return True
+            ok, _ = self._pipe_locked(watches, ops)
+            return ok
+
+    async def pipe_execute(
+        self, watches: dict[str, int], ops: list[tuple]
+    ) -> tuple[bool, dict[str, int]]:
+        self._observe_op("pipe", pipeline_size=len(ops))
+        async with self._lock:
+            return self._pipe_locked(watches, ops)
+
+
+# Per-op round-trip accounting: every public MemoryKV op takes the store lock
+# exactly once, so it is the in-process analogue of one wire round trip —
+# instrumented uniformly so `cordum_kv_roundtrips_total{op}` means the same
+# thing it means for StateBusKV (one TCP request) and bench.py can compute
+# kv_roundtrips_per_job against either backend.
+_COUNTED_OPS = (
+    "get", "set", "setnx", "delete", "del_eq", "expire", "keys",
+    "hset", "hget", "hgetall", "hdel", "hincrby",
+    "zadd", "zrem", "zrange", "zrangebyscore", "zcard", "zscore",
+    "rpush", "lrange", "ltrim", "llen", "sadd", "smembers",
+    "version", "watch_read", "commit",
+)
+
+
+def _counted(name: str, fn: Any) -> Any:
+    async def method(self: MemoryKV, *args: Any, **kwargs: Any) -> Any:
+        self._observe_op(name)
+        return await fn(self, *args, **kwargs)
+
+    method.__name__ = name
+    method.__doc__ = fn.__doc__
+    return method
+
+
+for _name in _COUNTED_OPS:
+    setattr(MemoryKV, _name, _counted(_name, getattr(MemoryKV, _name)))

@@ -42,6 +42,11 @@ class Counter:
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def total(self) -> float:
+        """Sum over every label combination (bench: round-trips per job)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def _snapshot(self) -> list[tuple[tuple[tuple[str, str], ...], float]]:
         with self._lock:
             return sorted(self._values.items())
@@ -163,6 +168,22 @@ class Metrics:
         self.batch_flushes = Counter(
             "cordum_batch_flushes_total", "Micro-batch flushes executed"
         )
+        # KV pipelining (infra/kv.py): every public KV op is one round trip
+        # (one TCP request under StateBusKV, one lock acquisition under
+        # MemoryKV); pipelined commits batch N mutations into one `pipe` op
+        self.kv_roundtrips = Counter(
+            "cordum_kv_roundtrips_total",
+            "KV operations issued (each is one round-trip under StateBusKV)",
+        )
+        self.kv_pipeline_size = Histogram(
+            "cordum_kv_pipeline_size",
+            "Ops folded into each pipelined KV commit",
+            buckets=(1.0, 2.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0, 64.0),
+        )
+        self.statebus_op_seconds = Histogram(
+            "cordum_statebus_op_seconds",
+            "Server-side statebus per-op execution latency",
+        )
         self._families = [
             self.jobs_received,
             self.jobs_dispatched,
@@ -182,6 +203,9 @@ class Metrics:
             self.batch_size,
             self.batch_queue_depth,
             self.batch_flushes,
+            self.kv_roundtrips,
+            self.kv_pipeline_size,
+            self.statebus_op_seconds,
         ]
 
     def render(self) -> str:

@@ -33,8 +33,17 @@ import msgpack
 from ..protocol.types import BusPacket
 from ..utils.globmatch import subject_match
 from . import logging as logx
-from .bus import Bus, DEDUP_WINDOW_S, MAX_REDELIVERIES, RetryAfter, Subscription, compute_msg_id
+from .bus import (
+    Bus,
+    DEDUP_WINDOW_S,
+    MAX_NAK_DELAY_S,
+    MAX_REDELIVERIES,
+    RetryAfter,
+    Subscription,
+    compute_msg_id,
+)
 from .kv import KV, MemoryKV
+from .metrics import Metrics
 
 _LEN = struct.Struct(">I")
 
@@ -46,7 +55,8 @@ def _read_bytes(path: str) -> bytes:
 
 # KV ops forwarded verbatim to the MemoryKV engine (name → is_mutation)
 _KV_OPS = {
-    "get": False, "set": True, "setnx": True, "delete": True, "expire": True,
+    "get": False, "set": True, "setnx": True, "delete": True, "del_eq": True,
+    "expire": True,
     "keys": False, "hset": True, "hget": False, "hgetall": False, "hdel": True,
     "hincrby": True, "zadd": True, "zrem": True, "zrange": False,
     "zrangebyscore": False, "zcard": False, "zscore": False, "rpush": True,
@@ -96,6 +106,9 @@ class StateBusServer:
         self._dedup: dict[str, float] = {}
         self._writers: set[asyncio.StreamWriter] = set()
         self._write_locks: dict[asyncio.StreamWriter, asyncio.Lock] = {}
+        # server-side observability: per-op execution latency + pipeline
+        # sizes; rendered via the `metrics` wire op (cordum_statebus_op_seconds)
+        self.metrics = Metrics()
 
     # -- lifecycle ------------------------------------------------------
     async def start(self) -> None:
@@ -180,10 +193,28 @@ class StateBusServer:
         req_id, op, *args = frame
         try:
             if op in _KV_OPS:
+                t0 = time.perf_counter()
                 result = await getattr(self.kv, op)(*args)
                 if _KV_OPS[op]:
                     self._log_aof(op, tuple(args))
+                self.metrics.statebus_op_seconds.observe(
+                    time.perf_counter() - t0, op=op
+                )
                 await self._send(writer, [req_id, "ok", _plain(result)])
+            elif op == "pipe":
+                # one wire frame = one atomic multi-op batch (the whole point
+                # of the pipeline layer: N mutations, ONE round trip)
+                watches, ops = args
+                t0 = time.perf_counter()
+                ok, versions = await self.kv.pipe_execute(watches, ops)
+                self._log_aof("pipe_execute", (watches, ops))
+                self.metrics.statebus_op_seconds.observe(
+                    time.perf_counter() - t0, op="pipe"
+                )
+                self.metrics.kv_pipeline_size.observe(float(len(ops)))
+                await self._send(writer, [req_id, "ok", [ok, versions]])
+            elif op == "metrics":
+                await self._send(writer, [req_id, "ok", self.metrics.render()])
             elif op == "sub":
                 pattern, queue = args
                 sid = next(self._sid)
@@ -477,6 +508,7 @@ def _make_kv_method(op: str) -> Any:
             args = bound.args[1:]
             if bound.kwargs:
                 args = (*args, *bound.kwargs.values())
+        self._observe_op(op)
         result = await self.conn.call(op, *args)
         if op == "smembers" and isinstance(result, list):
             return set(result)
@@ -497,10 +529,30 @@ for _op in _KV_OPS:
 
 
 async def _commit(self, watches: dict[str, int], ops: list[tuple]) -> bool:
+    self._observe_op("commit")
     return await self.conn.call("commit", watches, [list(o) for o in ops])
 
 
+async def _pipe_execute(
+    self, watches: dict[str, int], ops: list[tuple]
+) -> tuple[bool, dict[str, int]]:
+    """One PIPE wire frame: the whole batch rides a single request and gets
+    a single ``[ok, new_versions]`` reply — N ops, one TCP round trip."""
+    self._observe_op("pipe", pipeline_size=len(ops))
+    ok, versions = await self.conn.call("pipe", watches, [list(o) for o in ops])
+    return bool(ok), {
+        k if isinstance(k, str) else k.decode(): v for k, v in (versions or {}).items()
+    }
+
+
+async def _server_metrics(self) -> str:
+    """Server-side metrics exposition (cordum_statebus_op_seconds etc.)."""
+    return str(await self.conn.call("metrics"))
+
+
 StateBusKV.commit = _commit  # type: ignore[assignment]
+StateBusKV.pipe_execute = _pipe_execute  # type: ignore[assignment]
+StateBusKV.server_metrics = _server_metrics  # type: ignore[attr-defined]
 
 
 class StateBusBus(Bus):
@@ -516,17 +568,24 @@ class StateBusBus(Bus):
     async def subscribe(self, pattern: str, handler, *, queue: Optional[str] = None) -> Subscription:
         from ..protocol import subjects as subj
 
-        async def deliver(subject: str, packet_bytes: bytes, attempt: int = 1) -> None:
-            try:
-                await handler(subject, BusPacket.from_wire(packet_bytes))
-            except RetryAfter as ra:
-                if subj.is_durable_subject(subject) and attempt < MAX_REDELIVERIES:
-                    await asyncio.sleep(ra.delay_s)
-                    await deliver(subject, packet_bytes, attempt + 1)
-                else:
-                    logx.warn("dropping message after retries", subject=subject)
-            except Exception:
-                logx.error("bus handler error", subject=subject)
+        async def deliver(subject: str, packet_bytes: bytes) -> None:
+            # iterative redelivery (NOT recursive): a hot NAK cycle must not
+            # grow the stack across MAX_REDELIVERIES, and the requested delay
+            # is capped so one bad handler can't park a delivery task forever
+            attempt = 1
+            while True:
+                try:
+                    await handler(subject, BusPacket.from_wire(packet_bytes))
+                    return
+                except RetryAfter as ra:
+                    if not subj.is_durable_subject(subject) or attempt >= MAX_REDELIVERIES:
+                        logx.warn("dropping message after retries", subject=subject)
+                        return
+                    attempt += 1
+                    await asyncio.sleep(min(max(ra.delay_s, 0.0), MAX_NAK_DELAY_S))
+                except Exception:
+                    logx.error("bus handler error", subject=subject)
+                    return
 
         local = await self.conn.subscribe(pattern, queue or "", deliver)
 

@@ -1,0 +1,433 @@
+"""KV pipeline semantics (ISSUE 4): atomic multi-op commits, version-watched
+chains with conflict retry, partial-failure behavior, the wire-level PIPE
+frame, and the engine hot path rebuilt on pipelined commits.
+
+The `statebus`-marked tests run against a LIVE TCP StateBusServer — CI runs
+them as a dedicated step so the hot path can't silently regress to per-op
+wire calls.
+"""
+import asyncio
+import contextlib
+
+import pytest
+
+from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+from cordum_tpu.controlplane.scheduler.engine import Engine
+from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+from cordum_tpu.infra.bus import LoopbackBus, MAX_REDELIVERIES, RetryAfter
+from cordum_tpu.infra.config import parse_pool_config
+from cordum_tpu.infra.jobstore import JobStore, MetaSnapshot
+from cordum_tpu.infra.kv import MemoryKV, PIPELINE_OPS
+from cordum_tpu.infra.metrics import Metrics
+from cordum_tpu.infra.registry import WorkerRegistry
+from cordum_tpu.infra.statebus import StateBusServer, connect
+from cordum_tpu.protocol import subjects as subj
+from cordum_tpu.protocol.types import BusPacket, Heartbeat, JobRequest, JobResult, JobState
+
+BACKENDS = ("memory", "statebus")
+
+
+@contextlib.asynccontextmanager
+async def kv_backend(kind):
+    if kind == "memory":
+        yield MemoryKV()
+        return
+    srv = StateBusServer(port=0)
+    await srv.start()
+    kv, _bus, conn = await connect(f"statebus://127.0.0.1:{srv.port}")
+    try:
+        yield kv
+    finally:
+        await conn.close()
+        await srv.stop()
+
+
+# ------------------------------------------------------------- pipeline core
+
+@pytest.mark.parametrize("kind", BACKENDS)
+async def test_pipeline_atomic_multi_op(kind):
+    """Every op kind in one batch, applied atomically in one round trip."""
+    async with kv_backend(kind) as kv:
+        await kv.set("gone", b"x")
+        await kv.set("guard", b"me")
+        await kv.rpush("l", b"a", b"b", b"c", b"d")
+        p = kv.pipeline()
+        p.hset("h", {"f": b"1"}).hdel("h", "missing")
+        p.zadd("z", "m1", 1.0).zrem("z", "nope")
+        p.rpush("l", b"e").ltrim("l", -3, -1)
+        p.sadd("s", "a", "b")
+        p.set("k", b"v", 60.0).expire("h", 60.0)
+        p.delete("gone").del_eq("guard", b"me")
+        assert await p.execute() is True
+        assert await kv.hgetall("h") == {"f": b"1"}
+        assert await kv.zrange("z") == ["m1"]
+        assert await kv.lrange("l") == [b"c", b"d", b"e"]
+        assert await kv.smembers("s") == {"a", "b"}
+        assert await kv.get("k") == b"v"
+        assert await kv.get("gone") is None
+        assert await kv.get("guard") is None
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+async def test_pipeline_watch_semantics(kind):
+    """Version watches: version-0 means key-absent; any concurrent mutation
+    aborts the whole batch; new_versions supports read-free chaining."""
+    async with kv_backend(kind) as kv:
+        p = kv.pipeline().hset("w", {"a": b"1"})
+        p.watch("w", 0)  # key must not exist yet
+        assert await p.execute() is True
+        ver = p.new_versions["w"]
+        assert ver > 0
+        # chained commit using the returned version: no re-read needed
+        p2 = kv.pipeline().hset("w", {"b": b"2"})
+        p2.watch("w", ver)
+        assert await p2.execute() is True
+        # stale version → conflict, nothing applied
+        p3 = kv.pipeline().hset("w", {"c": b"3"}).set("other", b"x")
+        p3.watch("w", ver)
+        assert await p3.execute() is False
+        h = await kv.hgetall("w")
+        assert "c" not in h and await kv.get("other") is None
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+async def test_pipeline_conflict_retry_under_concurrent_writers(kind):
+    """Two optimistic writers increment one hash field through watched
+    pipelines; conflicts force re-reads and no increment is lost."""
+    async with kv_backend(kind) as kv:
+        async def incr(n):
+            for _ in range(n):
+                while True:
+                    ver, h = await kv.watch_read("counter")
+                    cur = int(h.get("n", b"0"))
+                    p = kv.pipeline().hset("counter", {"n": str(cur + 1).encode()})
+                    p.watch("counter", ver)
+                    if await p.execute():
+                        break
+        await asyncio.gather(incr(30), incr(30))
+        _, h = await kv.watch_read("counter")
+        assert int(h["n"]) == 60
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+async def test_pipeline_unknown_op_rejects_whole_batch(kind):
+    """Partial-failure behavior: ops are validated before anything applies —
+    an unknown op rejects the WHOLE batch and leaves state untouched."""
+    async with kv_backend(kind) as kv:
+        with pytest.raises((ValueError, RuntimeError)):
+            await kv.pipe_execute({}, [("hset", "h", {"a": b"1"}), ("bogus", "x")])
+        assert await kv.hgetall("h") == {}
+        # the buffered builder rejects unknown ops client-side too
+        with pytest.raises(ValueError):
+            kv.pipeline().op("hincrby", "h", "a")
+        assert "hincrby" not in PIPELINE_OPS
+
+
+@pytest.mark.statebus
+async def test_pipe_wire_frame_roundtrip():
+    """Wire level: one PIPE frame carries the whole batch and gets one
+    [ok, new_versions] reply — exactly one wire round trip per execute."""
+    srv = StateBusServer(port=0)
+    await srv.start()
+    kv, _bus, conn = await connect(f"statebus://127.0.0.1:{srv.port}")
+    try:
+        m = Metrics()
+        kv.bind_metrics(m)
+        # raw frame through the shared connection
+        ok, versions = await conn.call(
+            "pipe", {"k": 0}, [["set", "k", b"v", None], ["zadd", "z", "m", 1.0]]
+        )
+        assert ok is True and versions["k"] > 0
+        # a client Pipeline.execute() is exactly one counted round trip
+        p = kv.pipeline().hset("h", {"a": b"1"}).zadd("z2", "m", 2.0)
+        p.watch("h", 0)
+        assert await p.execute() is True
+        assert m.kv_roundtrips.value(op="pipe") == 1
+        assert m.kv_roundtrips.total() == 1  # no hidden per-op calls
+        assert m.kv_pipeline_size.quantile(0.5) >= 2
+        # conflict surfaces as ok=False in the same single reply
+        ok2, _ = await conn.call("pipe", {"h": 0}, [["set", "never", b"x", None]])
+        assert ok2 is False
+        assert await kv.get("never") is None
+        # server-side observability saw the pipe op
+        text = await kv.server_metrics()
+        assert 'cordum_statebus_op_seconds_count{op="pipe"}' in text
+    finally:
+        await conn.close()
+        await srv.stop()
+
+
+# ------------------------------------------------------------ jobstore chains
+
+@pytest.mark.parametrize("kind", BACKENDS)
+async def test_jobstore_apply_chain_matches_serial_transitions(kind):
+    """A DISPATCHED→RUNNING chain in one commit produces the same meta,
+    indexes and event log as two serial set_state calls."""
+    async with kv_backend(kind) as kv:
+        js = JobStore(kv)
+        # serial reference
+        await js.set_state("ser", JobState.PENDING, fields={"tenant_id": "t"})
+        await js.set_state("ser", JobState.SCHEDULED, event="scheduled")
+        await js.set_state("ser", JobState.DISPATCHED, event="dispatched")
+        await js.set_state("ser", JobState.RUNNING, event="running")
+        # chained
+        _, snap = await js.apply_chain(
+            "ch", [(JobState.PENDING, {"tenant_id": "t"}, "")], snap=MetaSnapshot()
+        )
+        _, snap = await js.apply_chain(
+            "ch",
+            [(JobState.SCHEDULED, None, "scheduled"),
+             (JobState.DISPATCHED, None, "dispatched"),
+             (JobState.RUNNING, None, "running")],
+            snap=snap,
+        )
+        assert await js.get_state("ch") == "RUNNING"
+        ser_events = [e["event"] for e in await js.events("ser")]
+        ch_events = [e["event"] for e in await js.events("ch")]
+        assert ch_events == ser_events
+        assert set(await js.list_by_state("RUNNING")) == {"ch", "ser"}
+        for st in ("PENDING", "SCHEDULED", "DISPATCHED"):
+            assert await js.list_by_state(st) == []
+        # terminal chain clears deadline + tenant membership atomically
+        await js.register_deadline("ch", 123)
+        await js.tenant_active_add("t", "ch")
+        _, snap = await js.apply_chain(
+            "ch", [(JobState.SUCCEEDED, None, "result")], snap=snap
+        )
+        assert await js.expired_deadlines(10_000) == []
+        assert await js.tenant_active_count("t") == 0
+        assert (await js.get_meta("ch"))["finished_at_us"]
+
+
+async def test_jobstore_snapshot_chaining_needs_no_rereads():
+    """The optimistic snapshot thread means a whole job lifecycle costs one
+    pipelined commit per transition group and ZERO extra read round trips."""
+    kv = MemoryKV()
+    m = Metrics()
+    kv.bind_metrics(m)
+    js = JobStore(kv)
+    _, snap = await js.apply_chain(
+        "j", [(JobState.PENDING, {"topic": "t"}, "submit")], snap=MetaSnapshot()
+    )
+    _, snap = await js.apply_chain("j", [(JobState.SCHEDULED, None, "")], snap=snap)
+    _, snap = await js.apply_chain(
+        "j",
+        [(JobState.DISPATCHED, None, ""), (JobState.RUNNING, None, "")],
+        snap=snap,
+    )
+    assert await js.get_state("j") == "RUNNING"  # 1 hget (not counted below)
+    assert m.kv_roundtrips.value(op="pipe") == 3
+    assert m.kv_roundtrips.value(op="watch_read") == 0
+
+
+async def test_jobstore_apply_chain_conflict_rereads_and_retries():
+    kv = MemoryKV()
+    js = JobStore(kv)
+    await js.set_state("j", JobState.PENDING)
+    stale = MetaSnapshot(1, {"state": b"PENDING"})  # wrong version on purpose
+    changed, snap = await js.apply_chain(
+        "j", [(JobState.SCHEDULED, None, "")], snap=stale
+    )
+    assert changed is True and snap.state == "SCHEDULED"
+    # exhausted retries surface as changed=None with a fresh snapshot
+    changed, snap = await js.apply_chain(
+        "j", [(JobState.DISPATCHED, None, "")],
+        snap=MetaSnapshot(10**9, {"state": b"SCHEDULED"}), max_retries=1,
+    )
+    assert changed is None and snap.state == "SCHEDULED"
+
+
+async def test_job_lock_release_is_compare_and_delete():
+    kv = MemoryKV()
+    js = JobStore(kv)
+    assert await js.acquire_job_lock("j", "owner-a")
+    await js.release_job_lock("j", "owner-b")  # wrong owner: no-op
+    assert not await js.acquire_job_lock("j", "owner-b")
+    await js.release_job_lock("j", "owner-a")
+    assert await js.acquire_job_lock("j", "owner-b")
+
+
+# --------------------------------------------------------------- bus redelivery
+
+async def test_hot_nak_cycle_is_iterative_and_capped(monkeypatch):
+    """A zero-delay NAK cycle redelivers MAX_REDELIVERIES times without
+    growing the stack, and huge RetryAfter delays are capped."""
+    import cordum_tpu.infra.bus as busmod
+
+    delays = []
+
+    async def fake_sleep(d):
+        delays.append(d)
+
+    monkeypatch.setattr(busmod.asyncio, "sleep", fake_sleep)
+    bus = LoopbackBus()
+    attempts = []
+    depths = []
+
+    async def h(subject, pkt):
+        import inspect
+
+        attempts.append(1)
+        depths.append(len(inspect.stack()))
+        raise RetryAfter(9999.0)
+
+    await bus.subscribe("sys.job.submit", h, queue="g")
+    await bus.publish("sys.job.submit", BusPacket.wrap(JobRequest(job_id="j", topic="t")))
+    await bus.drain()
+    assert len(attempts) == MAX_REDELIVERIES
+    assert len(set(depths)) == 1  # constant stack depth: iterative, not recursive
+    assert delays and all(d == busmod.MAX_NAK_DELAY_S for d in delays)
+    await bus.close()
+
+
+# --------------------------------------------------------------- engine level
+
+def _make_engine(kv, bus):
+    js = JobStore(kv)
+    kernel = SafetyKernel(policy_doc={})
+    reg = WorkerRegistry()
+    reg.update(Heartbeat(worker_id="w1", pool="default", max_parallel_jobs=1 << 30))
+    pc = parse_pool_config({"topics": {"job.default": "default"}, "pools": {"default": {}}})
+    eng = Engine(
+        bus=bus, job_store=js, safety=SafetyClient(kernel.check),
+        strategy=LeastLoadedStrategy(reg, pc), registry=reg,
+    )
+    return eng, js
+
+
+async def _worker_echo(bus):
+    async def handler(subject, pkt):
+        req = pkt.job_request
+        await bus.publish(
+            subj.RESULT,
+            BusPacket.wrap(
+                JobResult(job_id=req.job_id, status="SUCCEEDED", worker_id="w1"),
+                sender_id="w1",
+            ),
+        )
+
+    await bus.subscribe(subj.direct_subject("w1"), handler, queue="w")
+
+
+async def test_engine_concurrent_burst_matches_serial():
+    """64 jobs submitted concurrently under the bounded semaphore produce
+    exactly the same terminal states and event logs as serial processing."""
+    n = 64
+
+    # serial reference: one job at a time straight through the engine
+    kv_s = MemoryKV()
+    bus_s = LoopbackBus(sync=True)
+    eng_s, js_s = _make_engine(kv_s, bus_s)
+    for i in range(n):
+        await eng_s.handle_job_request(JobRequest(job_id=f"j{i}", topic="job.default"))
+        await eng_s.handle_job_result(JobResult(job_id=f"j{i}", status="SUCCEEDED"))
+    serial = {}
+    for i in range(n):
+        serial[f"j{i}"] = (
+            await js_s.get_state(f"j{i}"),
+            [e["event"] for e in await js_s.events(f"j{i}")],
+        )
+
+    # concurrent burst through the async bus
+    kv_c = MemoryKV()
+    bus_c = LoopbackBus()
+    eng_c, js_c = _make_engine(kv_c, bus_c)
+    await eng_c.start()
+    await _worker_echo(bus_c)
+    for i in range(n):
+        await bus_c.publish(
+            subj.SUBMIT,
+            BusPacket.wrap(JobRequest(job_id=f"j{i}", topic="job.default")),
+        )
+    for _ in range(200):
+        await bus_c.drain()
+        if eng_c.metrics.jobs_completed.value(status="SUCCEEDED") >= n:
+            break
+        await asyncio.sleep(0.01)
+    await eng_c.stop()
+    for i in range(n):
+        jid = f"j{i}"
+        state = await js_c.get_state(jid)
+        events = [e["event"] for e in await js_c.events(jid)]
+        assert (state, events) == serial[jid], jid
+    await bus_c.close()
+    await bus_s.close()
+
+
+@pytest.mark.statebus
+async def test_pipe_commits_survive_aof_replay(tmp_path):
+    """Pipelined commits are AOF-logged as single atomic entries and replay
+    on restart — the crash-safe-state guarantee holds for the new frame."""
+    aof = str(tmp_path / "state.aof")
+    srv = StateBusServer(port=0, aof_path=aof)
+    await srv.start()
+    kv, _bus, conn = await connect(f"statebus://127.0.0.1:{srv.port}")
+    js = JobStore(kv)
+    _, snap = await js.apply_chain(
+        "j1", [(JobState.PENDING, {"topic": "t"}, "submit")], snap=MetaSnapshot()
+    )
+    _, snap = await js.apply_chain(
+        "j1",
+        [(JobState.SCHEDULED, None, "scheduled"),
+         (JobState.DISPATCHED, None, "dispatched"),
+         (JobState.RUNNING, None, "running")],
+        snap=snap,
+    )
+    await conn.close()
+    await srv.stop()
+    srv2 = StateBusServer(port=0, aof_path=aof)
+    await srv2.start()
+    kv2, _bus2, conn2 = await connect(f"statebus://127.0.0.1:{srv2.port}")
+    try:
+        js2 = JobStore(kv2)
+        assert await js2.get_state("j1") == "RUNNING"
+        assert [e["event"] for e in await js2.events("j1")] == [
+            "submit", "scheduled", "dispatched", "running",
+        ]
+        assert await js2.list_by_state("RUNNING") == ["j1"]
+    finally:
+        await conn2.close()
+        await srv2.stop()
+
+
+@pytest.mark.statebus
+async def test_engine_hot_path_is_pipelined_over_live_statebus():
+    """End-to-end over a REAL TCP statebus: a 20-job burst completes, the
+    submit→result path stays under a hard per-job wire-round-trip budget,
+    and the pipelined commits actually ride PIPE frames (≥3 per job) — the
+    regression guard that keeps the hot path from decaying to per-op calls.
+    """
+    srv = StateBusServer(port=0)
+    await srv.start()
+    url = f"statebus://127.0.0.1:{srv.port}"
+    skv, sbus, sconn = await connect(url)  # scheduler process
+    wkv, wbus, wconn = await connect(url)  # worker process
+    try:
+        eng, js = _make_engine(skv, sbus)
+        await eng.start()
+        await _worker_echo(wbus)
+        n = 20
+        for i in range(n):
+            await sbus.publish(
+                subj.SUBMIT,
+                BusPacket.wrap(JobRequest(job_id=f"j{i}", topic="job.default")),
+            )
+        for _ in range(400):
+            if eng.metrics.jobs_completed.value(status="SUCCEEDED") >= n:
+                break
+            await asyncio.sleep(0.02)
+        assert eng.metrics.jobs_completed.value(status="SUCCEEDED") == n
+        for i in range(n):
+            assert await js.get_state(f"j{i}") == "SUCCEEDED"
+        # wire budget: submit→DISPATCHED→RUNNING→result used to cost ~20+
+        # round trips per job; pipelined it must stay in single digits
+        per_job = eng.metrics.kv_roundtrips.total() / n
+        assert per_job <= 10.0, f"kv round-trips/job regressed to {per_job:.1f}"
+        pipes_per_job = eng.metrics.kv_roundtrips.value(op="pipe") / n
+        assert pipes_per_job >= 3.0, "hot path no longer rides PIPE frames"
+        await eng.stop()
+    finally:
+        await sconn.close()
+        await wconn.close()
+        await srv.stop()
