@@ -31,6 +31,8 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import signal
+import socket
 import subprocess
 import sys
 import time
@@ -39,7 +41,14 @@ N_JOBS = int(os.environ.get("BENCH_JOBS", "3000"))
 PACED_JOBS = int(os.environ.get("BENCH_PACED_JOBS", "1500"))
 PACED_RATE = float(os.environ.get("BENCH_PACED_RATE", "1000"))  # jobs/s offered
 STATEBUS_JOBS = int(os.environ.get("BENCH_STATEBUS_JOBS", "600"))
+SHARDED_JOBS = int(os.environ.get("BENCH_SHARDED_JOBS", "2000"))
+SHARDS = int(os.environ.get("BENCH_SHARDS", "4"))
+SB_PARTITIONS = int(os.environ.get("BENCH_STATEBUS_PARTITIONS", "2"))
 JAX_TIMEOUT_S = float(os.environ.get("BENCH_JAX_TIMEOUT_S", "420"))
+# TPU backend discovery gets its own short watchdog: a hung PJRT grant on a
+# TPU-less host must become a clean {"skipped": ...} exit 0, not a
+# faulthandler rc=1 crash polluting the JSON (BENCH_r04/r05)
+TPU_PROBE_TIMEOUT_S = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT_S", "45"))
 BASELINE_JOBS_PER_SEC = 1000.0  # BASELINE.json north-star target
 
 # bf16 peak FLOP/s per chip by TPU generation (public spec sheets)
@@ -232,9 +241,10 @@ async def bench_latency() -> dict:
 
 class _PerOpPipelineKV:
     """Bench-only degraded KV: delegates every op to the wrapped StateBusKV
-    but downgrades ``pipeline()`` to one wire call PER buffered op (plus a
-    version read per watch) — the pre-pipelining wire behavior, so the
-    statebus bench can report before/after on the same run."""
+    but downgrades ``pipe_execute`` (the jobstore hot path calls it
+    directly) to one wire call PER buffered op, plus a version read per
+    watch — the pre-pipelining wire behavior, so the statebus bench can
+    report before/after on the same run."""
 
     def __init__(self, kv):
         self._kv = kv
@@ -242,22 +252,15 @@ class _PerOpPipelineKV:
     def __getattr__(self, name):
         return getattr(self._kv, name)
 
-    def pipeline(self):
-        from cordum_tpu.infra.kv import Pipeline
-
-        class _PerOp(Pipeline):
-            async def execute(self) -> bool:
-                kv = self._kv
-                for key, ver in self._watches.items():
-                    if await kv.version(key) != ver:
-                        return False
-                for op in self._ops:
-                    name, *args = op
-                    await getattr(kv, name)(*args)
-                self.new_versions = {k: await kv.version(k) for k in self._watches}
-                return True
-
-        return _PerOp(self._kv)
+    async def pipe_execute(self, watches, ops):
+        kv = self._kv
+        for key, ver in watches.items():
+            if await kv.version(key) != ver:
+                return False, {}
+        for op in ops:
+            name, *args = op
+            await getattr(kv, name)(*args)
+        return True, {k: await kv.version(k) for k in watches}
 
 
 async def bench_statebus(pipelined: bool, n_jobs: int) -> dict:
@@ -338,6 +341,245 @@ async def bench_statebus(pipelined: bool, n_jobs: int) -> dict:
         await srv.stop()
 
 
+# ---------------------------------------------------------------------------
+# sharded mode (ISSUE 5): S scheduler-shard PROCESSES over P statebus
+# partition PROCESSES — the real multi-process control plane, keyspace-
+# partitioned end to end (gateway-role submit stamps sys.job.submit.<p>,
+# each shard owns its jobs' full lifecycle, workers echo the partition on
+# results).  Child modes: `--statebus-child <port>` / `--shard-child i n urls`.
+# ---------------------------------------------------------------------------
+
+
+def _free_ports(n: int) -> list[int]:
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def _wait_for_stop() -> None:
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+
+def _statebus_child(port: int) -> None:
+    """One statebus partition server process."""
+    async def run() -> None:
+        from cordum_tpu.infra.statebus import StateBusServer
+
+        srv = StateBusServer(port=port)
+        await srv.start()
+        await _wait_for_stop()
+        await srv.stop()
+
+    asyncio.run(run())
+
+
+def _shard_child(index: int, count: int, urls: str) -> None:
+    """One scheduler shard process: engine shard `index` of `count` over the
+    partitioned statebus; reports completion counts through the KV so the
+    parent can observe end-to-end progress without sharing a process."""
+    async def run() -> None:
+        from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+        from cordum_tpu.controlplane.scheduler.engine import Engine
+        from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+        from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+        from cordum_tpu.infra.config import parse_pool_config
+        from cordum_tpu.infra.jobstore import JobStore
+        from cordum_tpu.infra.registry import WorkerRegistry
+        from cordum_tpu.infra.statebus import connect_partitioned
+        from cordum_tpu.protocol.types import Heartbeat
+
+        kv, bus, grp = await connect_partitioned(urls)
+        kernel = SafetyKernel(
+            policy_doc={"tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}}
+        )
+        reg = WorkerRegistry()
+        pc = parse_pool_config(
+            {"topics": {"job.bench": "bench"}, "pools": {"bench": {"requires": []}}}
+        )
+        eng = Engine(
+            bus=bus, job_store=JobStore(kv), safety=SafetyClient(kernel.check),
+            strategy=LeastLoadedStrategy(reg, pc), registry=reg,
+            instance_id=f"bench-shard-{index}", shard_index=index, shard_count=count,
+        )
+        # seed the local load view so the first dispatch cannot race the
+        # parent's first heartbeat (heartbeats keep refreshing it after)
+        reg.update(Heartbeat(worker_id="bench-w", pool="bench", max_parallel_jobs=1 << 30))
+        await eng.start()
+        await kv.set(f"bench:shard_ready:{index}", b"1")
+
+        done = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, done.set)
+
+        async def report() -> None:
+            while not done.is_set():
+                n = int(eng.metrics.jobs_completed.value(status="SUCCEEDED"))
+                await kv.set(f"bench:done:{index}", str(n).encode())
+                await asyncio.sleep(0.1)
+
+        rep = asyncio.ensure_future(report())
+        await done.wait()
+        rep.cancel()
+        try:  # best-effort final flush — the servers may already be gone
+            n = int(eng.metrics.jobs_completed.value(status="SUCCEEDED"))
+            await asyncio.wait_for(kv.set(f"bench:done:{index}", str(n).encode()), 2.0)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass  # parent already read the periodic reports; flush is advisory
+        await eng.stop()
+        await grp.close()
+
+    asyncio.run(run())
+
+
+async def bench_sharded(shards: int, partitions: int, n_jobs: int) -> dict:
+    """Keyspace-sharded schedule loop: `shards` engine processes ×
+    `partitions` statebus server processes, submits stamped to
+    ``sys.job.submit.<p>``, one worker role in the parent."""
+    from cordum_tpu.infra.statebus import connect_partitioned
+    from cordum_tpu.protocol import subjects as subj
+    from cordum_tpu.protocol.types import (
+        BusPacket, Heartbeat, JobRequest, JobResult, LABEL_PARTITION,
+    )
+
+    me = os.path.abspath(__file__)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    ports = _free_ports(partitions)
+    urls = ",".join(f"statebus://127.0.0.1:{p}" for p in ports)
+    procs = [
+        subprocess.Popen([sys.executable, me, "--statebus-child", str(p)],
+                         env=env, cwd=os.path.dirname(me))
+        for p in ports
+    ]
+    kv = bus = grp = None
+    hb_task = None
+    shard_procs: list[subprocess.Popen] = []
+    try:
+        deadline = time.monotonic() + 30
+        while True:  # servers up? (connect_partitioned dials every endpoint)
+            try:
+                kv, bus, grp = await connect_partitioned(urls)
+                break
+            except (OSError, ConnectionError):
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.1)
+        shard_procs = [
+            subprocess.Popen(
+                [sys.executable, me, "--shard-child", str(i), str(shards), urls],
+                env=env, cwd=os.path.dirname(me))
+            for i in range(shards)
+        ]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:  # every shard subscribed?
+            flags = await asyncio.gather(
+                *(kv.get(f"bench:shard_ready:{i}") for i in range(shards))
+            )
+            if all(flags):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("scheduler shards never became ready")
+
+        hb = Heartbeat(worker_id="bench-w", pool="bench", max_parallel_jobs=1 << 30)
+
+        async def heartbeats() -> None:
+            while True:
+                await bus.publish(subj.HEARTBEAT, BusPacket.wrap(hb, sender_id="bench-w"))
+                await asyncio.sleep(1.0)
+
+        hb_task = asyncio.ensure_future(heartbeats())
+
+        submitted: dict[str, float] = {}
+        done: dict[str, float] = {}
+        all_done = asyncio.Event()
+
+        async def worker_handler(subject, pkt):
+            req = pkt.job_request
+            # echo the owning shard's partition stamp → result routes
+            # straight to sys.job.result.<p>, no forwarding hop
+            await bus.publish(
+                subj.stamped_result_subject((req.labels or {}).get(LABEL_PARTITION, "")),
+                BusPacket.wrap(
+                    JobResult(job_id=req.job_id, status="SUCCEEDED", worker_id="bench-w"),
+                    sender_id="bench-w",
+                ),
+            )
+
+        async def result_tap(subject, pkt):
+            res = pkt.job_result
+            if res and res.job_id in submitted and res.job_id not in done:
+                done[res.job_id] = time.perf_counter() - submitted[res.job_id]
+                if len(done) >= n_jobs:
+                    all_done.set()
+
+        await bus.subscribe(subj.direct_subject("bench-w"), worker_handler, queue="w")
+        await bus.subscribe(subj.RESULT, result_tap)
+        await bus.subscribe(f"{subj.RESULT}.>", result_tap)
+
+        t0 = time.perf_counter()
+        for i in range(n_jobs):
+            jid = f"sh-{i}"
+            submitted[jid] = time.perf_counter()
+            await bus.publish(
+                subj.submit_subject_for(jid, shards),
+                BusPacket.wrap(
+                    JobRequest(job_id=jid, topic="job.bench", tenant_id="default"),
+                    sender_id="bench",
+                ),
+            )
+        try:
+            await asyncio.wait_for(all_done.wait(), timeout=120)
+        except asyncio.TimeoutError:
+            pass
+        dt = time.perf_counter() - t0
+
+        # the shards' own terminal commits (reported through the KV): proves
+        # every shard drove its partition's jobs to a terminal state
+        terminal = 0
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            vals = await asyncio.gather(
+                *(kv.get(f"bench:done:{i}") for i in range(shards))
+            )
+            terminal = sum(int(v or b"0") for v in vals)
+            if terminal >= n_jobs:
+                break
+            await asyncio.sleep(0.1)
+        lat = sorted(done.values())
+        return {
+            "shards": shards,
+            "statebus_partitions": partitions,
+            "jobs": len(done),
+            "jobs_per_sec": len(done) / dt if dt > 0 else 0.0,
+            "p50_e2e_ms": (lat[len(lat) // 2] * 1000) if lat else 0.0,
+            "terminal_total": terminal,
+        }
+    finally:
+        if hb_task:
+            hb_task.cancel()
+        if grp is not None:
+            await grp.close()  # before SIGTERM: no reconnect-warn churn
+        # shards first (their shutdown flushes through the servers), then
+        # the statebus partitions
+        for batch in (shard_procs, procs):
+            for p in batch:
+                p.send_signal(signal.SIGTERM)
+            for p in batch:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
 def bench_selection() -> dict:
     """Worker-selection throughput at 1000 workers (reference analogue:
     18,234 selections/s, BENCHMARKS.md:131)."""
@@ -378,6 +620,7 @@ def bench_selection() -> dict:
 
 def _jax_child(device: str) -> None:
     import faulthandler
+    import threading
 
     # watchdog: if the PJRT client wedges (e.g. TPU grant never arrives),
     # die with a traceback instead of hanging the driver
@@ -385,6 +628,28 @@ def _jax_child(device: str) -> None:
     if device == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
     out: dict = {}
+
+    # Backend-discovery watchdog (the BENCH_r04/r05 `child rc=1` fix): on
+    # hosts where libtpu is installed but no TPU is grantable, jax.devices()
+    # HANGS instead of raising, and the long faulthandler watchdog used to
+    # kill the child with rc=1 — violating the clean-skip contract.  A tpu
+    # probe that doesn't finish inside TPU_PROBE_TIMEOUT_S is a skip
+    # (exit 0, {"skipped": ...}); a hung CPU probe is a real failure.
+    probe_done = threading.Event()
+
+    def _probe_watchdog() -> None:
+        if probe_done.wait(TPU_PROBE_TIMEOUT_S):
+            return
+        if device == "tpu":
+            print(json.dumps({"skipped": "no tpu",
+                              "detail": "backend init timed out after "
+                                        f"{TPU_PROBE_TIMEOUT_S:.0f}s (TPU grant unavailable?)"}),
+                  flush=True)
+            os._exit(0)
+        faulthandler.dump_traceback()
+        os._exit(1)
+
+    threading.Thread(target=_probe_watchdog, daemon=True).start()
     try:
         import jax
 
@@ -392,6 +657,7 @@ def _jax_child(device: str) -> None:
             jax.config.update("jax_platforms", "cpu")
         devs = jax.devices()
     except Exception as ex:  # noqa: BLE001 - "no TPU" is an expected outcome
+        probe_done.set()
         if device == "tpu":
             # no TPU on this host is not a failure: exit cleanly so the
             # driver falls back to the cpu child without an embed_error
@@ -400,6 +666,7 @@ def _jax_child(device: str) -> None:
                   flush=True)
             return
         raise
+    probe_done.set()
     dev = devs[0]
     if device == "tpu" and dev.platform != "tpu":
         print(json.dumps({"skipped": "no tpu",
@@ -659,6 +926,12 @@ def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--jax-child":
         _jax_child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
         return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--statebus-child":
+        _statebus_child(int(sys.argv[2]))
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--shard-child":
+        _shard_child(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+        return
     smoke = "--smoke" in sys.argv
     if smoke:
         # CI sanity mode: small sizes, cpu-only compute child, same JSON shape
@@ -667,10 +940,16 @@ def main() -> None:
         PACED_RATE = min(PACED_RATE, 500.0)
         JAX_TIMEOUT_S = min(JAX_TIMEOUT_S, 240.0)
     sb_jobs = min(STATEBUS_JOBS, 150) if smoke else STATEBUS_JOBS
+    # smoke: 2 shards × 2 statebus partitions (the CI topology); full mode
+    # defaults to 4 × 2 (the ISSUE 5 acceptance topology)
+    shards = min(SHARDS, 2) if smoke else SHARDS
+    sh_jobs = min(SHARDED_JOBS, 300) if smoke else SHARDED_JOBS
     sched = asyncio.run(bench_scheduler())
     lat = asyncio.run(bench_latency())
     sb_pipe = asyncio.run(bench_statebus(True, sb_jobs))
     sb_perop = asyncio.run(bench_statebus(False, sb_jobs))
+    sharded = asyncio.run(bench_sharded(shards, SB_PARTITIONS, sh_jobs))
+    sharded_single = asyncio.run(bench_sharded(1, 1, sh_jobs))
     sel = bench_selection()
     jx = bench_jax(smoke=smoke)
     out = {
@@ -692,6 +971,20 @@ def main() -> None:
         "statebus_unpipelined_kv_roundtrips_per_job": round(
             sb_perop["kv_roundtrips_per_job"], 1
         ),
+        # keyspace-sharded control plane (ISSUE 5): S scheduler-shard
+        # processes over P statebus partition processes, vs the same
+        # multi-process harness at 1×1
+        "sharded_jobs_per_sec": round(sharded["jobs_per_sec"], 1),
+        "sharded_p50_e2e_ms": round(sharded["p50_e2e_ms"], 2),
+        "sharded_shards": sharded["shards"],
+        "sharded_statebus_partitions": sharded["statebus_partitions"],
+        "sharded_jobs": sharded["jobs"],
+        "sharded_jobs_terminal": sharded["terminal_total"],
+        "sharded_single_jobs_per_sec": round(sharded_single["jobs_per_sec"], 1),
+        "sharded_single_p50_e2e_ms": round(sharded_single["p50_e2e_ms"], 2),
+        "sharded_speedup": round(
+            sharded["jobs_per_sec"] / sharded_single["jobs_per_sec"], 2
+        ) if sharded_single["jobs_per_sec"] else 0.0,
         "p50_e2e_ms": round(lat.get("p50_e2e_ms", 0.0), 2),
         "p99_e2e_ms": round(lat.get("p99_e2e_ms", 0.0), 2),
         "stage_p50_ms": lat.get("stage_p50_ms", {}),

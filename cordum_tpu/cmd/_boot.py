@@ -18,9 +18,13 @@ def setup() -> Config:
 async def connect_statebus(cfg: Config):
     from ..infra import statebus
 
+    # comma-separated CORDUM_STATEBUS_URL connects the partitioned client
+    # (keyspace-routed KV + subject-routed bus); one endpoint is the plain
+    # single-server client wrapped in the same close-handle
     url = cfg.statebus_url or "statebus://127.0.0.1:7420"
-    kv, bus, conn = await statebus.connect(url)
-    logx.info("connected to statebus", url=url)
+    kv, bus, conn = await statebus.connect_partitioned(url)
+    logx.info("connected to statebus", url=url,
+              partitions=len(conn.conns))
     return kv, bus, conn
 
 

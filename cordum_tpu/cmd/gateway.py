@@ -51,6 +51,7 @@ async def main() -> None:
         ),
         rate_rps=_boot.env_float("API_RATE_LIMIT_RPS", 0.0),
         max_concurrent_runs=_boot.env_int("MAX_CONCURRENT_RUNS", 0),
+        scheduler_shards=cfg.scheduler_shards,
     )
     host, _, port = cfg.gateway_http_addr.partition(":")
     await gw.start(host or "127.0.0.1", int(port or 8081))

@@ -1,9 +1,16 @@
 """Scheduler service binary (reference ``cmd/cordum-scheduler/main.go:24-179``):
 statebus connection → job store → safety client (remote kernel or embedded)
 → pool config + overlay bootstrap/watch → engine + reconciler + pending
-replayer + worker-snapshot writer → shutdown on signal."""
+replayer + worker-snapshot writer → shutdown on signal.
+
+Keyspace sharding: run N copies with ``--shard-index i --shard-count n``
+(or SCHEDULER_SHARD_INDEX / SCHEDULER_SHARD_COUNT, or ``scheduler.shards``
+in pools.yaml for the count); shard i owns every job whose
+``partition_of(job_id, n) == i`` and consumes ``sys.job.submit.<i>`` /
+``result.<i>`` / ``cancel.<i>`` with no cross-shard locks."""
 from __future__ import annotations
 
+import argparse
 import asyncio
 import os
 
@@ -24,8 +31,20 @@ from ..infra.config import load_pool_config, load_timeouts
 from . import _boot
 
 
+def _shard_args() -> tuple[int, int]:
+    """CLI flags > env vars > pools.yaml ``scheduler.shards`` (count only)."""
+    ap = argparse.ArgumentParser(description="cordum scheduler shard")
+    ap.add_argument("--shard-index", type=int,
+                    default=_boot.env_int("SCHEDULER_SHARD_INDEX", 0))
+    ap.add_argument("--shard-count", type=int,
+                    default=_boot.env_int("SCHEDULER_SHARD_COUNT", 0))
+    ns, _ = ap.parse_known_args()
+    return ns.shard_index, ns.shard_count
+
+
 async def main() -> None:
     cfg = _boot.setup()
+    shard_index, shard_count = _shard_args()
     kv, bus, conn = await _boot.connect_statebus(cfg)
     job_store = JobStore(kv)
     configsvc = ConfigService(kv)
@@ -34,6 +53,8 @@ async def main() -> None:
     pool_cfg = load_pool_config(cfg.pool_config_path)
     timeouts = load_timeouts(cfg.timeout_config_path)
     strategy = LeastLoadedStrategy(registry, pool_cfg)
+    if shard_count <= 0:  # flag/env unset: pools.yaml scheduler.shards
+        shard_count = pool_cfg.scheduler_shards
 
     kernel_addr = cfg.safety_kernel_addr
     if kernel_addr:
@@ -52,8 +73,13 @@ async def main() -> None:
     engine = Engine(
         bus=bus, job_store=job_store, safety=safety, strategy=strategy,
         registry=registry, configsvc=configsvc,
-        instance_id=os.environ.get("SCHEDULER_ID", "scheduler-0"),
+        instance_id=os.environ.get(
+            "SCHEDULER_ID",
+            f"scheduler-{shard_index}" if shard_count > 1 else "scheduler-0",
+        ),
         tenant_concurrency_limit=_boot.env_int("TENANT_CONCURRENCY_LIMIT", 0),
+        shard_index=shard_index,
+        shard_count=max(1, shard_count),
     )
     reconciler = Reconciler(job_store, timeouts, instance_id=engine.instance_id)
     replayer = PendingReplayer(engine, job_store, timeouts)
@@ -80,7 +106,8 @@ async def main() -> None:
     await replayer.start()
     await overlay.start()
     await snapshotter.start()
-    logx.info("scheduler running", instance=engine.instance_id)
+    logx.info("scheduler running", instance=engine.instance_id,
+              shard=engine.shard_index, shards=engine.shard_count)
     try:
         await _boot.wait_for_shutdown()
     finally:

@@ -1,4 +1,12 @@
-"""Statebus server binary: ``python -m cordum_tpu.cmd.statebus``."""
+"""Statebus server binary: ``python -m cordum_tpu.cmd.statebus``.
+
+``STATEBUS_PARTITIONS=N`` serves N keyspace partitions from one process on
+consecutive ports (STATEBUS_PORT .. STATEBUS_PORT+N-1), each with its own
+AOF (``<STATEBUS_AOF>.<p>``) — the dev/smoke topology.  Production runs one
+process per partition: ``STATEBUS_PARTITION_INDEX=p`` starts only partition
+``p`` on ``STATEBUS_PORT+p``.  Clients list every endpoint in
+``CORDUM_STATEBUS_URL`` (comma-separated) and route by keyspace.
+"""
 from __future__ import annotations
 
 import asyncio
@@ -8,17 +16,31 @@ from ..infra.statebus import StateBusServer
 from . import _boot
 
 
+def _aof_path(base: str, partition: int, partitions: int) -> str:
+    if not base:
+        return ""
+    return base if partitions <= 1 else f"{base}.{partition}"
+
+
 async def main() -> None:
     _boot.setup()
     host = os.environ.get("STATEBUS_HOST", "127.0.0.1")
     port = _boot.env_int("STATEBUS_PORT", 7420)
     aof = os.environ.get("STATEBUS_AOF", "")
-    srv = StateBusServer(host, port, aof_path=aof)
-    await srv.start()
+    partitions = max(1, _boot.env_int("STATEBUS_PARTITIONS", 1))
+    only = _boot.env_int("STATEBUS_PARTITION_INDEX", -1)
+    indices = [only] if 0 <= only < partitions else list(range(partitions))
+    servers = [
+        StateBusServer(host, port + p, aof_path=_aof_path(aof, p, partitions))
+        for p in indices
+    ]
+    for srv in servers:
+        await srv.start()
     try:
         await _boot.wait_for_shutdown()
     finally:
-        await srv.stop()
+        for srv in servers:
+            await srv.stop()
 
 
 if __name__ == "__main__":

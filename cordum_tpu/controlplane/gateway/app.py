@@ -49,6 +49,7 @@ from ...obs.collector import SpanCollector
 from ...obs.tracer import Tracer
 from ...protocol import subjects as subj
 from ...protocol.jobhash import job_hash
+from ...protocol.partition import partition_of
 from ...protocol.types import (
     Budget,
     BusPacket,
@@ -101,6 +102,7 @@ class Gateway:
         max_concurrent_runs: int = 0,
         ws_allowed_origins: Optional[list[str]] = None,
         instance_id: str = "gateway-0",
+        scheduler_shards: int = 1,
     ):
         self.kv = kv
         self.bus = bus
@@ -127,6 +129,10 @@ class Gateway:
         self.max_concurrent_runs = max_concurrent_runs
         self.ws_allowed_origins = ws_allowed_origins
         self.instance_id = instance_id
+        # keyspace-sharded scheduler: the gateway stamps the partition at
+        # submit time by publishing straight to the owner shard's subject
+        # (sys.job.submit.<p>); 1 = unsharded plain subjects
+        self.scheduler_shards = max(1, scheduler_shards)
         self._ws_clients: set[web.WebSocketResponse] = set()
         self._subs: list = []
         self._runner: Optional[web.AppRunner] = None
@@ -464,7 +470,7 @@ class Gateway:
             await self.job_store.put_request(req)
             await self.job_store.add_to_trace(trace_id, job_id)
             await self.bus.publish(
-                subj.SUBMIT,
+                subj.submit_subject_for(job_id, self.scheduler_shards),
                 BusPacket.wrap(
                     req, trace_id=trace_id, sender_id=self.instance_id,
                     span_id=sp.span_id,
@@ -505,7 +511,9 @@ class Gateway:
         if not await self.job_store.get_meta(job_id):
             return _err(404, f"unknown job {job_id}")
         await self.bus.publish(
-            subj.CANCEL,
+            subj.cancel_subject(
+                partition_of(job_id, self.scheduler_shards), self.scheduler_shards
+            ),
             BusPacket.wrap(
                 JobCancel(job_id=job_id, reason="api cancel", requested_by=principal.principal_id),
                 sender_id=self.instance_id,
@@ -558,7 +566,10 @@ class Gateway:
             event="remediate",
         )
         await self.job_store.put_request(new_req)
-        await self.bus.publish(subj.SUBMIT, BusPacket.wrap(new_req, sender_id=self.instance_id))
+        await self.bus.publish(
+            subj.submit_subject_for(new_id_, self.scheduler_shards),
+            BusPacket.wrap(new_req, sender_id=self.instance_id),
+        )
         return web.json_response({"job_id": new_id_, "remediated_from": job_id}, status=202)
 
     # ------------------------------------------------------------------
@@ -611,7 +622,10 @@ class Gateway:
         republish.labels = dict(republish.labels or {})
         republish.labels[LABEL_APPROVAL_GRANTED] = "true"
         republish.labels[LABEL_BUS_MSG_ID] = f"approve-{job_id}-{now_us()}"
-        await self.bus.publish(subj.SUBMIT, BusPacket.wrap(republish, sender_id=self.instance_id))
+        await self.bus.publish(
+            subj.submit_subject_for(job_id, self.scheduler_shards),
+            BusPacket.wrap(republish, sender_id=self.instance_id),
+        )
         return web.json_response({"job_id": job_id, "approved": True})
 
     async def reject_job(self, request: web.Request) -> web.Response:
@@ -787,7 +801,10 @@ class Gateway:
             event="dlq_retry",
         )
         await self.job_store.put_request(req)
-        await self.bus.publish(subj.SUBMIT, BusPacket.wrap(req, sender_id=self.instance_id))
+        await self.bus.publish(
+            subj.submit_subject_for(new_jid, self.scheduler_shards),
+            BusPacket.wrap(req, sender_id=self.instance_id),
+        )
         await self.dlq.delete(job_id)
         return new_jid
 

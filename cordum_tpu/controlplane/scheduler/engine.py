@@ -35,6 +35,7 @@ from ...infra.registry import WorkerRegistry
 from ...obs.tracer import Tracer
 from ...protocol import subjects as subj
 from ...protocol.jobhash import job_hash
+from ...protocol.partition import partition_of
 from ...utils.ids import now_us
 from ...protocol.types import (
     BusPacket,
@@ -45,6 +46,7 @@ from ...protocol.types import (
     JobResult,
     JobState,
     LABEL_APPROVAL_GRANTED,
+    LABEL_PARTITION,
     PolicyCheckRequest,
     TERMINAL_STATES,
 )
@@ -79,6 +81,8 @@ class Engine:
         tenant_concurrency_limit: int = 0,
         tracer: Optional[Tracer] = None,
         submit_concurrency: int = DEFAULT_SUBMIT_CONCURRENCY,
+        shard_index: int = 0,
+        shard_count: int = 1,
     ):
         self.bus = bus
         self.tracer = tracer or Tracer("scheduler", bus)
@@ -96,6 +100,17 @@ class Engine:
         # can't spawn unbounded tasks all hammering the state bus at once
         self.submit_concurrency = max(1, submit_concurrency)
         self._sem = asyncio.Semaphore(self.submit_concurrency)
+        # keyspace sharding (ISSUE 5): shard i of n owns every job with
+        # partition_of(job_id, n) == i and consumes its hash-partitioned
+        # lifecycle subjects; there is NO cross-shard lock — worker load and
+        # batch affinity live in per-shard caches fed by fan-out heartbeats
+        # and tolerate bounded staleness (docs/PROTOCOL.md §Partitioning)
+        if not (0 <= shard_index < max(1, shard_count)):
+            raise ValueError(f"shard_index {shard_index} out of range for {shard_count} shards")
+        self.shard_index = shard_index
+        self.shard_count = max(1, shard_count)
+        self._shard_label = str(shard_index)
+        self._inflight = 0  # submit backlog gauge (cordum_shard_partition_queue_depth)
         self._subs = []
         # kv round-trip accounting (cordum_kv_roundtrips_total{op}) for the
         # store this engine drives — the bench's kv_roundtrips_per_job source
@@ -103,6 +118,9 @@ class Engine:
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
+        # plain subjects stay subscribed even when sharded: they are the
+        # unstamped-publisher fallback — whichever shard draws the message
+        # from the queue group forwards it to the owner's partition subject
         self._subs = [
             await self.bus.subscribe(subj.SUBMIT, self._on_submit, queue=subj.QUEUE_SCHEDULER),
             await self.bus.subscribe(subj.RESULT, self._on_result, queue=subj.QUEUE_SCHEDULER),
@@ -110,11 +128,39 @@ class Engine:
             await self.bus.subscribe(subj.HEARTBEAT, self._on_heartbeat),
             await self.bus.subscribe(subj.PROGRESS, self._on_progress),
         ]
+        if self.shard_count > 1:
+            # this shard's slice of the keyspace: its own partition subjects
+            # (queue groups so replicas of one shard still split the load)
+            q = f"{subj.QUEUE_SCHEDULER}-{self.shard_index}"
+            self._subs += [
+                await self.bus.subscribe(
+                    subj.submit_subject(self.shard_index, self.shard_count),
+                    self._on_submit, queue=q),
+                await self.bus.subscribe(
+                    subj.result_subject(self.shard_index, self.shard_count),
+                    self._on_result, queue=q),
+                await self.bus.subscribe(
+                    subj.cancel_subject(self.shard_index, self.shard_count),
+                    self._on_cancel, queue=q),
+            ]
 
     async def stop(self) -> None:
         for s in self._subs:
             s.unsubscribe()
         self._subs = []
+
+    # ------------------------------------------------------------------
+    def owns(self, job_id: str) -> bool:
+        return partition_of(job_id, self.shard_count) == self.shard_index
+
+    async def _forward_to_owner(
+        self, kind: str, job_id: str, subject_fn, pkt: BusPacket
+    ) -> None:
+        """Route an unstamped message to the owning shard's partition
+        subject (one extra bus hop; the stamped fast path skips it)."""
+        p = partition_of(job_id, self.shard_count)
+        self.metrics.shard_forwarded.inc(kind=kind, shard=self._shard_label)
+        await self.bus.publish(subject_fn(p, self.shard_count), pkt)
 
     # ------------------------------------------------------------------
     async def _on_heartbeat(self, subject: str, pkt: BusPacket) -> None:
@@ -130,6 +176,8 @@ class Engine:
         pr = pkt.job_progress
         if pr is None or not pr.job_id:
             return
+        if not self.owns(pr.job_id):
+            return  # progress fans out to every shard; only the owner records
         await self.job_store.append_event(
             pr.job_id, "progress", percent=pr.percent, message=pr.message
         )
@@ -137,6 +185,9 @@ class Engine:
     async def _on_cancel(self, subject: str, pkt: BusPacket) -> None:
         c = pkt.job_cancel
         if c is None or not c.job_id:
+            return
+        if not self.owns(c.job_id):
+            await self._forward_to_owner("cancel", c.job_id, subj.cancel_subject, pkt)
             return
         if await self.job_store.cancel_job(c.job_id):
             await self.job_store.append_event(c.job_id, "cancelled", reason=c.reason)
@@ -146,10 +197,19 @@ class Engine:
         req = pkt.job_request
         if req is None or not req.job_id or not req.topic:
             return
-        async with self._sem:
-            await self.handle_job_request(
-                req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
-            )
+        if not self.owns(req.job_id):
+            await self._forward_to_owner("submit", req.job_id, subj.submit_subject, pkt)
+            return
+        self._inflight += 1
+        self.metrics.shard_queue_depth.set(float(self._inflight), shard=self._shard_label)
+        try:
+            async with self._sem:
+                await self.handle_job_request(
+                    req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
+                )
+        finally:
+            self._inflight -= 1
+            self.metrics.shard_queue_depth.set(float(self._inflight), shard=self._shard_label)
 
     async def handle_job_request(
         self, req: JobRequest, *, trace_id: str = "", parent_span_id: str = ""
@@ -343,6 +403,7 @@ class Engine:
                   {"dispatch_subject": target, **pending_fields}, "scheduled")],
                 snap=snap, extra_ops=extra_ops,
             )
+            self._stamp_partition(req)
             out = BusPacket.wrap(
                 req, trace_id=trace_id, sender_id=self.instance_id,
                 span_id=dsp.span_id, parent_span_id=dsp.parent_span_id,
@@ -367,9 +428,18 @@ class Engine:
                 if isinstance(r, BaseException):
                     raise r
         self.metrics.jobs_dispatched.inc(topic=req.topic)
+        self.metrics.shard_scheduled.inc(shard=self._shard_label)
         sub_us = int(snap.get("submitted_at_us", "0") or 0)
         if sub_us:
             self.metrics.dispatch_latency.observe(max(0.0, (now_us() - sub_us) / 1e6))
+
+    def _stamp_partition(self, req: JobRequest) -> None:
+        """Stamp this shard's partition on the outbound request so the
+        worker can publish the result straight to ``sys.job.result.<p>``
+        (skipping the unstamped-result forwarding hop)."""
+        if self.shard_count > 1:
+            req.labels = dict(req.labels or {})
+            req.labels[LABEL_PARTITION] = self._shard_label
 
     # ------------------------------------------------------------------
     async def redispatch_scheduled(self, job_id: str) -> bool:
@@ -409,6 +479,7 @@ class Engine:
             # window even if the original publish reached the bus
             req.labels = dict(req.labels or {})
             req.labels["cordum.bus_msg_id"] = f"redispatch-{job_id}-{attempts}"
+            self._stamp_partition(req)
             out = BusPacket.wrap(req, trace_id=snap.get("trace_id", ""),
                                  sender_id=self.instance_id)
             await self.bus.publish(target, out)
@@ -512,6 +583,9 @@ class Engine:
     async def _on_result(self, subject: str, pkt: BusPacket) -> None:
         res = pkt.job_result
         if res is None or not res.job_id:
+            return
+        if not self.owns(res.job_id):
+            await self._forward_to_owner("result", res.job_id, subj.result_subject, pkt)
             return
         async with self._sem:
             await self.handle_job_result(

@@ -157,12 +157,20 @@ class PendingReplayer:
                 pass
 
     async def run_once(self) -> int:
-        cutoff_us = now_us() - int(self.timeouts.dispatch_timeout_s * 1e6)
+        # PENDING gets its own (short) cutoff: a submit that exhausted its
+        # bus redeliveries under backpressure, or whose owner shard was
+        # down, must resurface in seconds — replays are idempotent
+        pending_cutoff_us = now_us() - int(self.timeouts.pending_replay_s * 1e6)
         stuck = await self.job_store.list_by_state_older_than(
-            JobState.PENDING.value, cutoff_us, BATCH
+            JobState.PENDING.value, pending_cutoff_us, BATCH
         )
         n = 0
         for job_id in stuck:
+            if not self.engine.owns(job_id):
+                # sharded: a job parked while its owner shard was down is
+                # replayed by the OWNER after restart, preserving the
+                # no-cross-shard-ownership invariant (ISSUE 5 degraded mode)
+                continue
             req = await self.job_store.get_request(job_id)
             if req is None:
                 continue
@@ -175,10 +183,13 @@ class PendingReplayer:
         # set_state(SCHEDULED) and the dispatch publish): the submit-path
         # in-flight short-circuit deliberately ignores redeliveries for these,
         # so the replayer re-drives the dispatch leg directly
+        dispatch_cutoff_us = now_us() - int(self.timeouts.dispatch_timeout_s * 1e6)
         wedged = await self.job_store.list_by_state_older_than(
-            JobState.SCHEDULED.value, cutoff_us, BATCH
+            JobState.SCHEDULED.value, dispatch_cutoff_us, BATCH
         )
         for job_id in wedged:
+            if not self.engine.owns(job_id):
+                continue
             try:
                 if await self.engine.redispatch_scheduled(job_id):
                     n += 1

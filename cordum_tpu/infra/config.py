@@ -22,6 +22,8 @@ import yaml
 
 @dataclass
 class Config:
+    # one endpoint, or a comma-separated list of statebus partition
+    # endpoints (infra.statebus.connect_partitioned routes by keyspace)
     statebus_url: str = ""
     safety_kernel_addr: str = ""
     pool_config_path: str = ""
@@ -32,6 +34,10 @@ class Config:
     metrics_addr: str = ""
     api_keys: list[str] = field(default_factory=list)
     log_format: str = ""
+    # scheduler keyspace sharding: total shard count the publishers stamp
+    # partitions for (CORDUM_SCHEDULER_SHARDS; pools.yaml `scheduler.shards`
+    # overrides for the scheduler binary itself)
+    scheduler_shards: int = 1
 
 
 def load() -> Config:
@@ -48,6 +54,7 @@ def load() -> Config:
         metrics_addr=env.get("METRICS_ADDR", ""),
         api_keys=keys,
         log_format=env.get("CORDUM_LOG_FORMAT", ""),
+        scheduler_shards=max(1, int(env.get("CORDUM_SCHEDULER_SHARDS", "1") or 1)),
     )
 
 
@@ -75,6 +82,9 @@ class Pool:
 class PoolConfig:
     topics: dict[str, list[str]] = field(default_factory=dict)  # topic -> pool names
     pools: dict[str, Pool] = field(default_factory=dict)
+    # scheduler.shards: keyspace shard count for the scheduler fleet (each
+    # shard binary also needs its --shard-index); 1 = unsharded
+    scheduler_shards: int = 1
 
     def pools_for_topic(self, topic: str) -> list[Pool]:
         names = self.topics.get(topic)
@@ -110,6 +120,7 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
         if isinstance(pools, str):
             pools = [pools]
         cfg.topics[topic] = list(pools or [])
+    cfg.scheduler_shards = max(1, int((doc.get("scheduler") or {}).get("shards") or 1))
     return cfg
 
 
@@ -133,6 +144,12 @@ class Timeouts:
     dispatch_timeout_s: float = 300.0
     running_timeout_s: float = 9000.0
     scan_interval_s: float = 30.0
+    # how long a job may sit PENDING before the replayer re-drives it.
+    # Deliberately much shorter than dispatch_timeout_s: a PENDING job whose
+    # submit exhausted its bus redeliveries (tenant-concurrency backpressure
+    # on a burst, or its owner shard being down) is safe to replay early —
+    # the job lock + in-flight short-circuit make replays idempotent.
+    pending_replay_s: float = 15.0
     per_workflow: dict[str, float] = field(default_factory=dict)
     per_topic: dict[str, float] = field(default_factory=dict)
 
@@ -146,6 +163,7 @@ def parse_timeouts(doc: dict, *, source: str = "timeouts") -> Timeouts:
     t.dispatch_timeout_s = float(rec.get("dispatch_timeout_seconds", t.dispatch_timeout_s))
     t.running_timeout_s = float(rec.get("running_timeout_seconds", t.running_timeout_s))
     t.scan_interval_s = float(rec.get("scan_interval_seconds", t.scan_interval_s))
+    t.pending_replay_s = float(rec.get("pending_replay_seconds", t.pending_replay_s))
     t.per_workflow = {k: float(v) for k, v in (doc.get("workflows") or {}).items()}
     t.per_topic = {k: float(v) for k, v in (doc.get("topics") or {}).items()}
     return t

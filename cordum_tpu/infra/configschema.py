@@ -63,6 +63,13 @@ POOLS_SCHEMA: dict[str, Any] = {
                 }],
             },
         },
+        # scheduler keyspace sharding (ISSUE 5): total shard count; each
+        # shard binary picks its index via --shard-index / SCHEDULER_SHARD_INDEX
+        "scheduler": {
+            "type": "object",
+            "properties": {"shards": {"type": "integer", "minimum": 1}},
+            "additionalProperties": False,
+        },
         # tolerated here so one file can carry pools + reconciler (dev mode)
         "reconciler": {"type": "object"},
     },
@@ -83,6 +90,7 @@ TIMEOUTS_SCHEMA: dict[str, Any] = {
                 "dispatch_timeout_seconds": _NONNEG,
                 "running_timeout_seconds": _NONNEG,
                 "scan_interval_seconds": _NONNEG,
+                "pending_replay_seconds": _NONNEG,
             },
             "additionalProperties": False,
         },

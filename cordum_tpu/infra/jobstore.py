@@ -243,12 +243,14 @@ class JobStore:
                 ops = [*ops, *extra_ops]
             if not ops:
                 return False, snap
-            pipe = self.kv.pipeline().extend(ops)
-            pipe.watch(key, snap.version)
-            if await pipe.execute():
+            # direct pipe_execute: _chain_ops only emits PIPELINE_OPS names
+            # (re-validated store-side), so the Pipeline buffering/validation
+            # layer is pure overhead on this hot path (BENCH_r05 regression)
+            ok, versions = await self.kv.pipe_execute({key: snap.version}, ops)
+            if ok:
                 merged = dict(snap.fields)
                 merged.update(overlay)
-                return changed, MetaSnapshot(pipe.new_versions.get(key, 0), merged)
+                return changed, MetaSnapshot(versions.get(key, 0), merged)
             snap = None  # lost the race: re-read on the next attempt
         return None, await self.watch_meta(job_id)
 
@@ -283,8 +285,7 @@ class JobStore:
         ]
 
     async def set_fields(self, job_id: str, fields: dict[str, str]) -> None:
-        pipe = self.kv.pipeline().extend(self.set_fields_ops(job_id, fields))
-        await pipe.execute()
+        await self.kv.pipe_execute({}, self.set_fields_ops(job_id, fields))
 
     async def is_terminal(self, job_id: str) -> bool:
         st = await self.get_state(job_id)
@@ -325,10 +326,10 @@ class JobStore:
     # ------------------------------------------------------------------
     async def append_event(self, job_id: str, event: str, **kw: Any) -> None:
         ev = {"ts_us": now_us(), "event": event, **kw}
-        pipe = self.kv.pipeline()
-        pipe.rpush(events_key(job_id), json.dumps(ev).encode())
-        pipe.ltrim(events_key(job_id), -EVENTS_CAP, -1)
-        await pipe.execute()
+        await self.kv.pipe_execute({}, [
+            ("rpush", events_key(job_id), json.dumps(ev).encode()),
+            ("ltrim", events_key(job_id), -EVENTS_CAP, -1),
+        ])
 
     async def events(self, job_id: str) -> list[dict]:
         return [json.loads(b) for b in await self.kv.lrange(events_key(job_id))]

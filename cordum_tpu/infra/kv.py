@@ -41,10 +41,12 @@ class TxnConflict(Exception):
 
 
 # Mutating ops a Pipeline may buffer (superset of the old commit() op table).
-PIPELINE_OPS = (
+# frozenset: membership checks sit on the scheduler hot path (one per
+# buffered op), and a tuple scan was measurable at bench job rates.
+PIPELINE_OPS = frozenset((
     "set", "delete", "hset", "hdel", "zadd", "zrem", "rpush", "ltrim",
     "sadd", "expire", "del_eq",
-)
+))
 
 
 class Pipeline:
@@ -295,6 +297,9 @@ class MemoryKV(KV):
         self._data: dict[str, _Entry] = {}
         self._lock = asyncio.Lock()
         self._global_version = 0
+        # bound-method op table: resolved once here instead of a name →
+        # attr-name → getattr chain per op inside every pipelined commit
+        self._bound_ops = {name: getattr(self, attr) for name, attr in self._OPS.items()}
 
     # internal helpers (caller holds lock) --------------------------------
     def _live(self, key: str) -> Optional[_Entry]:
@@ -594,20 +599,20 @@ class MemoryKV(KV):
         unknown op rejects the whole batch (never a partial application),
         then checks watches and applies.  Returns post-commit versions of
         the watched keys."""
+        bound = self._bound_ops
         appliers = []
         for op in ops:
-            name = op[0]
-            applier = self._OPS.get(name)
+            applier = bound.get(op[0])
             if applier is None:
-                raise ValueError(f"unknown pipeline op {name!r}")
-            appliers.append((applier, op[1:]))
+                raise ValueError(f"unknown pipeline op {op[0]!r}")
+            appliers.append((applier, op))
         for key, ver in watches.items():
             e = self._live(key)
             cur = e.version if e is not None else 0
             if cur != ver:
                 return False, {}
-        for applier, args in appliers:
-            getattr(self, applier)(*args)
+        for applier, op in appliers:
+            applier(*op[1:])
         versions: dict[str, int] = {}
         for key in watches:
             e = self._live(key)

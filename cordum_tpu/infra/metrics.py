@@ -184,6 +184,26 @@ class Metrics:
             "cordum_statebus_op_seconds",
             "Server-side statebus per-op execution latency",
         )
+        # control-plane sharding (ISSUE 5): per-shard ownership throughput,
+        # cross-shard forwarding, submit backlog, and the per-connection
+        # write-coalescing batch sizes on the statebus wire
+        self.shard_scheduled = Counter(
+            "cordum_shard_scheduled_total",
+            "Jobs scheduled, labeled by owning scheduler shard",
+        )
+        self.shard_forwarded = Counter(
+            "cordum_shard_forwarded_total",
+            "Unstamped messages forwarded to the owning shard's partition subject",
+        )
+        self.shard_queue_depth = Gauge(
+            "cordum_shard_partition_queue_depth",
+            "Submits in flight (queued + processing) on this shard",
+        )
+        self.statebus_coalesced_batch = Histogram(
+            "cordum_statebus_coalesced_batch",
+            "Wire frames folded into one coalesced statebus socket write",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
+        )
         self._families = [
             self.jobs_received,
             self.jobs_dispatched,
@@ -206,6 +226,10 @@ class Metrics:
             self.kv_roundtrips,
             self.kv_pipeline_size,
             self.statebus_op_seconds,
+            self.shard_scheduled,
+            self.shard_forwarded,
+            self.shard_queue_depth,
+            self.statebus_coalesced_batch,
         ]
 
     def render(self) -> str:

@@ -30,6 +30,7 @@ from typing import Any, Optional
 
 import msgpack
 
+from ..protocol.partition import partition_of
 from ..protocol.types import BusPacket
 from ..utils.globmatch import subject_match
 from . import logging as logx
@@ -71,6 +72,64 @@ def _encode(obj: Any) -> bytes:
     return _LEN.pack(len(b)) + b
 
 
+class _FrameWriter:
+    """Per-connection write coalescer.
+
+    ``send()`` enqueues a frame synchronously; one flusher task drains the
+    accumulated batch per wakeup.  N replies (or N pipelined requests)
+    produced in one event-loop tick cost ONE socket write + drain instead
+    of N lock/write/drain cycles — without this, pipelined commits arriving
+    from many scheduler shards interleave into tiny writes and the
+    per-frame ``drain()`` syscalls dominate the statebus hot path.
+    Batch sizes surface as ``cordum_statebus_coalesced_batch``.
+    """
+
+    __slots__ = ("_writer", "_buf", "_wake", "_task", "_metrics", "_closed")
+
+    def __init__(self, writer: asyncio.StreamWriter, metrics: Optional[Metrics] = None) -> None:
+        self._writer = writer
+        self._buf: list[bytes] = []
+        self._wake = asyncio.Event()
+        self._metrics = metrics
+        self._closed = False
+        self._task = asyncio.ensure_future(self._run())
+
+    def send(self, frame: bytes) -> None:
+        if self._closed:
+            raise ConnectionError("statebus frame writer closed")
+        self._buf.append(frame)
+        self._wake.set()
+
+    async def _run(self) -> None:
+        try:
+            while not self._closed:
+                await self._wake.wait()
+                self._wake.clear()
+                if not self._buf:
+                    continue
+                buf, self._buf = self._buf, []
+                if self._metrics is not None:
+                    self._metrics.statebus_coalesced_batch.observe(float(len(buf)))
+                self._writer.write(buf[0] if len(buf) == 1 else b"".join(buf))
+                # drain AFTER the batch: backpressure throttles the flusher
+                # (and everything queued behind it), never individual sends
+                await self._writer.drain()
+        except asyncio.CancelledError:
+            raise
+        except (ConnectionError, OSError):
+            # peer gone mid-flush: subsequent send() raises; the owning
+            # connection's read loop drives recovery/teardown
+            self._closed = True
+
+    async def close(self) -> None:
+        self._closed = True
+        self._task.cancel()
+        try:
+            await self._task
+        except (asyncio.CancelledError, ConnectionError, OSError):
+            pass
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> Optional[list]:
     try:
         head = await reader.readexactly(4)
@@ -105,7 +164,7 @@ class StateBusServer:
         self._rr: dict[tuple[str, str], int] = {}
         self._dedup: dict[str, float] = {}
         self._writers: set[asyncio.StreamWriter] = set()
-        self._write_locks: dict[asyncio.StreamWriter, asyncio.Lock] = {}
+        self._fws: dict[asyncio.StreamWriter, _FrameWriter] = {}
         # server-side observability: per-op execution latency + pipeline
         # sizes; rendered via the `metrics` wire op (cordum_statebus_op_seconds)
         self.metrics = Metrics()
@@ -166,28 +225,34 @@ class StateBusServer:
     # -- connection handling -------------------------------------------
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         self._writers.add(writer)
-        self._write_locks[writer] = asyncio.Lock()
+        fw = _FrameWriter(writer, self.metrics)
+        self._fws[writer] = fw
         try:
             while True:
                 frame = await _read_frame(reader)
                 if frame is None:
                     break
-                asyncio.ensure_future(self._dispatch(frame, writer))
+                # inline dispatch (no per-frame task): KV ops are pure memory
+                # and replies are buffered, so a frame costs no task churn
+                # and a connection's ops apply in arrival order
+                await self._dispatch(frame, writer)
         finally:
             self._writers.discard(writer)
-            self._write_locks.pop(writer, None)
+            self._fws.pop(writer, None)
+            await fw.close()
             dead = [sid for sid, (w, _, _) in self._subs.items() if w is writer]
             for sid in dead:
                 del self._subs[sid]
             writer.close()
 
     async def _send(self, writer: asyncio.StreamWriter, obj: list) -> None:
-        lock = self._write_locks.get(writer)
-        if lock is None:
+        fw = self._fws.get(writer)
+        if fw is None:
             return
-        async with lock:
-            writer.write(_encode(obj))
-            await writer.drain()
+        try:
+            fw.send(_encode(obj))
+        except ConnectionError:
+            pass  # peer mid-teardown; its handler cleans up
 
     async def _dispatch(self, frame: list, writer: asyncio.StreamWriter) -> None:
         req_id, op, *args = frame
@@ -295,7 +360,7 @@ class StateBusConn:
         self._pending: dict[int, asyncio.Future] = {}
         self._handlers: dict[int, Any] = {}  # server sid → async handler(subject, bytes)
         self._reader_task: Optional[asyncio.Task] = None
-        self._lock = asyncio.Lock()
+        self._fw: Optional[_FrameWriter] = None
         self._closed = False
         self._reconnect = reconnect
         self._max_backoff_s = max_backoff_s
@@ -319,7 +384,10 @@ class StateBusConn:
             # a reader for a dead/obsolete connection must not linger (its
             # tail would spawn a second reconnect loop → duplicate dials)
             self._reader_task.cancel()
+        if self._fw is not None:
+            await self._fw.close()
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._fw = _FrameWriter(self._writer)
         self._epoch += 1
         self._reader_task = asyncio.ensure_future(self._read_loop())
         self._connected.set()
@@ -331,6 +399,8 @@ class StateBusConn:
             self._reconnect_task.cancel()
         if self._reader_task:
             self._reader_task.cancel()
+        if self._fw is not None:
+            await self._fw.close()
         if self._writer:
             self._writer.close()
         # deliberate close: resolve pending calls quietly (no orphan-task spam)
@@ -467,10 +537,11 @@ class StateBusConn:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
         try:
-            async with self._lock:
-                self._writer.write(_encode([req_id, op, *args]))
-                await self._writer.drain()
-        except (ConnectionError, OSError) as e:
+            # coalesced write: the frame enqueues synchronously and rides the
+            # connection's next batched flush — concurrent in-flight calls
+            # (engine submit_concurrency) share one socket write per tick
+            self._fw.send(_encode([req_id, op, *args]))
+        except (AttributeError, ConnectionError, OSError) as e:
             self._pending.pop(req_id, None)
             raise ConnectionError(f"statebus call {op!r} failed: {e}")
         try:
@@ -609,3 +680,306 @@ async def connect(url: str = "") -> tuple[StateBusKV, StateBusBus, StateBusConn]
     conn = StateBusConn(host or "127.0.0.1", int(port or 7420))
     await conn.connect()
     return StateBusKV(conn), StateBusBus(conn), conn
+
+
+# ---------------------------------------------------------------------------
+# partitioned statebus: N independent servers, clients route by keyspace
+# ---------------------------------------------------------------------------
+
+# Keys whose trailing segment is the routing id: every key of one job (or
+# trace) lands on ONE partition, which is what keeps pipelined commits —
+# always watched on job:meta:<id> — atomic on a single server.
+_ID_ROUTED_PREFIXES = (
+    "job:meta:", "job:events:", "job:request:", "job:safety:",
+    "job:approval:", "lock:job:", "trace:spans:",
+)
+
+# Shared index containers whose members are job ids.  They are mutated
+# INSIDE job-routed pipes, so each partition holds the slice for the ids it
+# owns: standalone writes route by member, reads fan out and merge.
+_MEMBER_ROUTED_EXACT = frozenset(("job:recent", "job:deadline"))
+_MEMBER_ROUTED_PREFIXES = ("job:index:", "job:tenant_active:", "trace:")
+
+
+def _route_key(key: str) -> str:
+    for p in _ID_ROUTED_PREFIXES:
+        if key.startswith(p):
+            return key[len(p):] or key
+    return key
+
+
+def _member_routed(key: str) -> bool:
+    if key in _MEMBER_ROUTED_EXACT:
+        return True
+    if key.startswith("trace:spans:"):
+        return False  # id-routed (collector span ring buffers + their index)
+    return key.startswith(_MEMBER_ROUTED_PREFIXES)
+
+
+class PartitionedKV(KV):
+    """KV facade over N statebus partitions (docs/PROTOCOL.md §Partitioning).
+
+    Point ops route by :func:`_route_key` hash; member-routed index
+    containers write to ``partition_of(member)`` and merge reads across
+    every partition (union / sum; cross-partition ordering of merged
+    listings is approximate — they are observability surfaces).  A pipeline
+    executes atomically on the partition of its first watched key, which by
+    construction is the job's home partition for every control-plane pipe.
+    """
+
+    def __init__(self, parts: list[KV]) -> None:
+        self.parts = list(parts)
+        self.n = len(self.parts)
+
+    def bind_metrics(self, metrics: Any) -> None:
+        self.metrics = metrics
+        for p in self.parts:
+            p.bind_metrics(metrics)
+
+    def _one(self, key: str) -> KV:
+        if self._member_is_global(key):
+            return self.parts[0]  # deterministic home for member-routed point ops
+        return self.parts[partition_of(_route_key(key), self.n)]
+
+    @staticmethod
+    def _member_is_global(key: str) -> bool:
+        return _member_routed(key)
+
+    def _by_member(self, member: str) -> KV:
+        return self.parts[partition_of(member, self.n)]
+
+    # strings -------------------------------------------------------------
+    async def get(self, key):
+        return await self._one(key).get(key)
+
+    async def set(self, key, value, ttl_s=None):
+        return await self._one(key).set(key, value, ttl_s)
+
+    async def setnx(self, key, value, ttl_s=None):
+        return await self._one(key).setnx(key, value, ttl_s)
+
+    async def delete(self, *keys):
+        grouped: dict[int, list[str]] = {}
+        for k in keys:
+            if self._member_is_global(k):
+                for i in range(self.n):  # slices live on every partition
+                    grouped.setdefault(i, []).append(k)
+            else:
+                grouped.setdefault(partition_of(_route_key(k), self.n), []).append(k)
+        counts = await asyncio.gather(
+            *(self.parts[i].delete(*ks) for i, ks in grouped.items())
+        )
+        return sum(counts)
+
+    async def del_eq(self, key, expect):
+        return await self._one(key).del_eq(key, expect)
+
+    async def expire(self, key, ttl_s):
+        if self._member_is_global(key):
+            oks = await asyncio.gather(*(p.expire(key, ttl_s) for p in self.parts))
+            return any(oks)
+        return await self._one(key).expire(key, ttl_s)
+
+    async def keys(self, prefix=""):
+        lists = await asyncio.gather(*(p.keys(prefix) for p in self.parts))
+        return sorted({k for ks in lists for k in ks})
+
+    # hashes --------------------------------------------------------------
+    async def hset(self, key, mapping):
+        return await self._one(key).hset(key, mapping)
+
+    async def hget(self, key, field):
+        return await self._one(key).hget(key, field)
+
+    async def hgetall(self, key):
+        return await self._one(key).hgetall(key)
+
+    async def hdel(self, key, *fields):
+        return await self._one(key).hdel(key, *fields)
+
+    async def hincrby(self, key, field, amount=1):
+        return await self._one(key).hincrby(key, field, amount)
+
+    # sorted sets ---------------------------------------------------------
+    async def zadd(self, key, member, score):
+        if self._member_is_global(key):
+            return await self._by_member(member).zadd(key, member, score)
+        return await self._one(key).zadd(key, member, score)
+
+    async def zrem(self, key, *members):
+        if self._member_is_global(key):
+            grouped: dict[int, list[str]] = {}
+            for m in members:
+                grouped.setdefault(partition_of(m, self.n), []).append(m)
+            counts = await asyncio.gather(
+                *(self.parts[i].zrem(key, *ms) for i, ms in grouped.items())
+            )
+            return sum(counts)
+        return await self._one(key).zrem(key, *members)
+
+    async def zrange(self, key, start=0, stop=-1, desc=False):
+        if not self._member_is_global(key):
+            return await self._one(key).zrange(key, start, stop, desc)
+        # merged listing: fetch each partition's slice of the requested
+        # range and concatenate (per-partition order exact, cross-partition
+        # approximate — observability surfaces only)
+        per_stop = -1 if stop == -1 else stop
+        lists = await asyncio.gather(
+            *(p.zrange(key, 0, per_stop, desc) for p in self.parts)
+        )
+        merged = [m for ms in lists for m in ms]
+        if stop == -1:
+            return merged[start:]
+        return merged[start: stop + 1]
+
+    async def zrangebyscore(self, key, min_score, max_score, limit=0):
+        if not self._member_is_global(key):
+            return await self._one(key).zrangebyscore(key, min_score, max_score, limit)
+        lists = await asyncio.gather(
+            *(p.zrangebyscore(key, min_score, max_score, limit) for p in self.parts)
+        )
+        merged = [m for ms in lists for m in ms]
+        return merged[:limit] if limit else merged
+
+    async def zcard(self, key):
+        if not self._member_is_global(key):
+            return await self._one(key).zcard(key)
+        return sum(await asyncio.gather(*(p.zcard(key) for p in self.parts)))
+
+    async def zscore(self, key, member):
+        if self._member_is_global(key):
+            return await self._by_member(member).zscore(key, member)
+        return await self._one(key).zscore(key, member)
+
+    # lists ---------------------------------------------------------------
+    async def rpush(self, key, *values):
+        return await self._one(key).rpush(key, *values)
+
+    async def lrange(self, key, start=0, stop=-1):
+        return await self._one(key).lrange(key, start, stop)
+
+    async def ltrim(self, key, start, stop):
+        return await self._one(key).ltrim(key, start, stop)
+
+    async def llen(self, key):
+        return await self._one(key).llen(key)
+
+    # sets ----------------------------------------------------------------
+    async def sadd(self, key, *members):
+        if self._member_is_global(key):
+            grouped: dict[int, list[str]] = {}
+            for m in members:
+                grouped.setdefault(partition_of(m, self.n), []).append(m)
+            counts = await asyncio.gather(
+                *(self.parts[i].sadd(key, *ms) for i, ms in grouped.items())
+            )
+            return sum(counts)
+        return await self._one(key).sadd(key, *members)
+
+    async def smembers(self, key):
+        if not self._member_is_global(key):
+            return await self._one(key).smembers(key)
+        sets = await asyncio.gather(*(p.smembers(key) for p in self.parts))
+        out: set[str] = set()
+        for s in sets:
+            out |= s
+        return out
+
+    # transactions --------------------------------------------------------
+    async def version(self, key):
+        return await self._one(key).version(key)
+
+    async def watch_read(self, key):
+        return await self._one(key).watch_read(key)
+
+    def _pipe_part(self, watches: dict[str, int], ops: list[tuple]) -> KV:
+        for key in watches:
+            return self._one(key)
+        for op in ops:
+            if len(op) > 1 and isinstance(op[1], str):
+                return self._one(op[1])
+        return self.parts[0]
+
+    async def commit(self, watches, ops):
+        return await self._pipe_part(watches, ops).commit(watches, ops)
+
+    async def pipe_execute(self, watches, ops):
+        return await self._pipe_part(watches, ops).pipe_execute(watches, ops)
+
+    async def ping(self):
+        oks = await asyncio.gather(*(p.ping() for p in self.parts))
+        return all(oks)
+
+    async def close(self):
+        await asyncio.gather(*(p.close() for p in self.parts), return_exceptions=True)
+
+
+class PartitionedBus(Bus):
+    """Bus facade over N statebus partitions.
+
+    A concrete subject always lives on ONE partition (hash of the subject
+    string), so queue-group and dedupe semantics stay exact per subject;
+    wildcard patterns are subscribed on every partition.  Hashing spreads
+    the partitioned lifecycle subjects (``sys.job.submit.<p>`` …) across
+    brokers so no single event loop serializes the fleet's messaging.
+    """
+
+    def __init__(self, buses: list[Bus]) -> None:
+        self.buses = list(buses)
+        self.n = len(self.buses)
+
+    def _bus_for(self, subject: str) -> Bus:
+        return self.buses[partition_of(subject, self.n)]
+
+    async def publish(self, subject: str, pkt: BusPacket) -> None:
+        await self._bus_for(subject).publish(subject, pkt)
+
+    async def subscribe(self, pattern: str, handler, *, queue: Optional[str] = None) -> Subscription:
+        if "*" in pattern or ">" in pattern:
+            subs = await asyncio.gather(
+                *(b.subscribe(pattern, handler, queue=queue) for b in self.buses)
+            )
+
+            def _unsub_all() -> None:
+                for s in subs:
+                    s.unsubscribe()
+
+            return Subscription(_unsub_all)
+        return await self._bus_for(pattern).subscribe(pattern, handler, queue=queue)
+
+    async def ping(self) -> bool:
+        oks = await asyncio.gather(*(b.ping() for b in self.buses))
+        return all(oks)
+
+
+class ConnGroup:
+    """Close-handle over the N connections behind a partitioned client."""
+
+    def __init__(self, conns: list[StateBusConn]) -> None:
+        self.conns = list(conns)
+
+    async def close(self) -> None:
+        await asyncio.gather(*(c.close() for c in self.conns), return_exceptions=True)
+
+
+async def connect_partitioned(url: str = "") -> tuple[KV, Bus, ConnGroup]:
+    """Connect to one or more statebus partitions.
+
+    ``url`` is a comma-separated list of ``statebus://host:port`` endpoints
+    (env ``CORDUM_STATEBUS_URL``); a single endpoint degrades to the plain
+    unpartitioned client, so every service binary can use this entry point.
+    """
+    url = url or os.environ.get("CORDUM_STATEBUS_URL", "statebus://127.0.0.1:7420")
+    endpoints = [u.strip() for u in url.split(",") if u.strip()]
+    if len(endpoints) <= 1:
+        kv, bus, conn = await connect(endpoints[0] if endpoints else "")
+        return kv, bus, ConnGroup([conn])
+    kvs: list[KV] = []
+    buses: list[Bus] = []
+    conns: list[StateBusConn] = []
+    for ep in endpoints:
+        kv, bus, conn = await connect(ep)
+        kvs.append(kv)
+        buses.append(bus)
+        conns.append(conn)
+    return PartitionedKV(kvs), PartitionedBus(buses), ConnGroup(conns)

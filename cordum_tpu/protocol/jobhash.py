@@ -13,7 +13,9 @@ import json
 
 from .types import ENV_EFFECTIVE_CONFIG, JobRequest
 
-_EXCLUDED_LABEL_PREFIXES = ("approval_", "cordum.bus_msg_id")
+# cordum.partition is shard-routing metadata stamped at dispatch time; it
+# must not shift the hash an approval was bound to before sharding existed
+_EXCLUDED_LABEL_PREFIXES = ("approval_", "cordum.bus_msg_id", "cordum.partition")
 _EXCLUDED_ENV_KEYS = (ENV_EFFECTIVE_CONFIG,)
 
 

@@ -25,12 +25,58 @@ def direct_subject(worker_id: str) -> str:
     return f"worker.{worker_id}.jobs"
 
 
+# -- keyspace-partitioned lifecycle subjects (sharded scheduler) -----------
+# Shard ``i`` of ``n`` owns every job with partition_of(job_id, n) == i and
+# consumes its slice via ``sys.job.submit.<i>`` / ``sys.job.result.<i>`` /
+# ``sys.job.cancel.<i>``.  The plain subjects stay live as the unstamped
+# fallback: whichever shard draws an unstamped message from the queue group
+# forwards it to the owner's partition subject (docs/PROTOCOL.md).
+
+def submit_subject(partition: int, partition_count: int) -> str:
+    """Submit subject for a partition; plain SUBMIT when unsharded."""
+    if partition_count <= 1:
+        return SUBMIT
+    return f"{SUBMIT}.{partition}"
+
+
+def result_subject(partition: int, partition_count: int) -> str:
+    if partition_count <= 1:
+        return RESULT
+    return f"{RESULT}.{partition}"
+
+
+def cancel_subject(partition: int, partition_count: int) -> str:
+    if partition_count <= 1:
+        return CANCEL
+    return f"{CANCEL}.{partition}"
+
+
+def submit_subject_for(job_id: str, partition_count: int) -> str:
+    """Partition-stamped submit subject for a job (gateway/SDK submit leg)."""
+    from .partition import partition_of
+
+    return submit_subject(partition_of(job_id, partition_count), partition_count)
+
+
+def stamped_result_subject(partition_label: str) -> str:
+    """Result subject for a request that carries ``LABEL_PARTITION``
+    (workers echo the owning shard's partition); plain RESULT otherwise."""
+    if partition_label.isdigit():
+        return f"{RESULT}.{partition_label}"
+    return RESULT
+
+
 def is_durable_subject(subject: str) -> bool:
     """Subjects that get at-least-once semantics under the durable bus
     (reference nats.go:369-381: submit/result/dlq/job.*/worker.*.jobs;
-    TRACE_SPAN added so a bus blip cannot silently hole a trace)."""
+    TRACE_SPAN added so a bus blip cannot silently hole a trace; the
+    partitioned ``sys.job.submit.<p>``/``result.<p>``/``cancel.<p>``
+    variants inherit their parents' durability)."""
     if subject in (SUBMIT, RESULT, DLQ, TRACE_SPAN):
         return True
+    for parent in (SUBMIT, RESULT, CANCEL):
+        if subject.startswith(parent + "."):
+            return True
     if subject.startswith(JOB_PREFIX):
         return True
     if subject.startswith(WORKER_PREFIX) and subject.endswith(".jobs"):

@@ -590,6 +590,12 @@ BATCHABLE_OPS = frozenset({"embed", "infer"})
 # the payload behind the context pointer.
 LABEL_BATCH_KEY = "cordum.batch_key"
 
+# Shard-routing label: the scheduler shard stamps its partition index on the
+# dispatched request so the worker can publish the result straight to the
+# owning shard's ``sys.job.result.<p>`` subject (no forwarding hop).  Pure
+# routing metadata — excluded from the approval job hash (protocol/jobhash).
+LABEL_PARTITION = "cordum.partition"
+
 
 def payload_batch_key(payload: Any) -> str:
     """The batch key for a job payload: the batchable op name, or ``""``

@@ -12,6 +12,10 @@ from typing import Any, Optional
 
 import httpx
 
+from ..protocol.partition import partition_of  # noqa: F401 - re-export: lets
+# partition-aware clients (load generators, shard-pinned tooling) pre-compute
+# which scheduler shard will own a job id they submit
+
 TERMINAL_JOB_STATES = {"SUCCEEDED", "FAILED", "CANCELLED", "TIMEOUT", "DENIED"}
 TERMINAL_RUN_STATES = {"SUCCEEDED", "FAILED", "CANCELLED"}
 
@@ -77,8 +81,14 @@ class Client:
         priority: str = "BATCH",
         idempotency_key: str = "",
         memory_id: str = "",
+        job_id: str = "",
     ) -> dict:
+        """Submit one job.  ``job_id`` pins the id client-side (the sharded
+        gateway stamps the owning scheduler partition from it — see
+        :func:`partition_of`); empty lets the gateway mint one."""
         body: dict[str, Any] = {"topic": topic, "payload": payload, "priority": priority}
+        if job_id:
+            body["job_id"] = job_id
         if metadata:
             body["metadata"] = metadata
         if labels:

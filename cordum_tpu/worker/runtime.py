@@ -44,6 +44,7 @@ from ..protocol.types import (
     JobRequest,
     JobResult,
     JobState,
+    LABEL_PARTITION,
     Span,
 )
 from ..utils.ids import new_id
@@ -264,7 +265,8 @@ class Worker:
             copy.labels = dict(copy.labels or {})
             copy.labels["cordum.bus_msg_id"] = f"republish-{req.job_id}-{time.monotonic_ns()}"
             await self.bus.publish(
-                subj.RESULT, BusPacket.wrap(copy, trace_id=trace_id, sender_id=self.worker_id)
+                self._result_subject(req),
+                BusPacket.wrap(copy, trace_id=trace_id, sender_id=self.worker_id),
             )
             return
         if payload is _UNFETCHED:
@@ -364,12 +366,18 @@ class Worker:
             for k in list(itertools.islice(self._completed, self._completed_cap // 2)):
                 del self._completed[k]
         await self.bus.publish(
-            subj.RESULT,
+            self._result_subject(req),
             BusPacket.wrap(
                 res, trace_id=trace_id, sender_id=self.worker_id,
                 span_id=exec_span.span_id, parent_span_id=exec_span.parent_span_id,
             ),
         )
+
+    @staticmethod
+    def _result_subject(req: JobRequest) -> str:
+        """Sharded schedulers stamp their partition on the dispatch; echoing
+        it routes the result straight to the owning shard (no forwarding)."""
+        return subj.stamped_result_subject((req.labels or {}).get(LABEL_PARTITION, ""))
 
     # ------------------------------------------------------------------
     async def publish_progress(self, job_id: str, percent: float, message: str = "") -> None:

@@ -318,7 +318,7 @@ async def test_pending_replayer_redrives():
     await js.put_request(req)
     await js.set_state("j1", JobState.PENDING)
     await asyncio.sleep(0.01)
-    rep = PendingReplayer(eng, js, Timeouts(dispatch_timeout_s=0.0))
+    rep = PendingReplayer(eng, js, Timeouts(dispatch_timeout_s=0.0, pending_replay_s=0.0))
     n = await rep.run_once()
     assert n == 1
     assert await js.get_state("j1") == "RUNNING"
@@ -340,7 +340,7 @@ async def test_replayer_redispatches_wedged_scheduled():
     assert await js.get_state("j1") == "SCHEDULED"
     assert not [p for s, p in bus.published if s == "worker.w1.jobs"]
     # the replayer recovers it through the dispatch leg
-    rep = PendingReplayer(eng, js, Timeouts(dispatch_timeout_s=0.0))
+    rep = PendingReplayer(eng, js, Timeouts(dispatch_timeout_s=0.0, pending_replay_s=0.0))
     n = await rep.run_once()
     assert n == 1
     assert await js.get_state("j1") == "RUNNING"

@@ -1,0 +1,488 @@
+"""Keyspace-sharded control plane (ISSUE 5): partition ownership, shard
+equivalence, degraded mode, the partitioned statebus client, and the
+coalesced wire path."""
+from __future__ import annotations
+
+import asyncio
+import subprocess
+import sys
+
+import pytest
+
+from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+from cordum_tpu.controlplane.scheduler.engine import Engine
+from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+from cordum_tpu.infra.bus import LoopbackBus
+from cordum_tpu.infra.config import parse_pool_config
+from cordum_tpu.infra.jobstore import JobStore
+from cordum_tpu.infra.kv import MemoryKV
+from cordum_tpu.infra.registry import WorkerRegistry
+from cordum_tpu.infra.statebus import (
+    PartitionedBus,
+    PartitionedKV,
+    StateBusServer,
+    connect_partitioned,
+)
+from cordum_tpu.protocol import subjects as subj
+from cordum_tpu.protocol.jobhash import job_hash
+from cordum_tpu.protocol.partition import owns, partition_of
+from cordum_tpu.protocol.types import (
+    BusPacket,
+    Heartbeat,
+    JobRequest,
+    JobResult,
+    JobState,
+    LABEL_PARTITION,
+)
+from cordum_tpu.worker.runtime import Worker
+
+
+# ---------------------------------------------------------------------------
+# partition function
+# ---------------------------------------------------------------------------
+
+
+def test_partition_of_golden_values():
+    """Frozen expectations: a change here re-shuffles ownership of every
+    in-flight job across a rolling restart — never change silently."""
+    assert partition_of("job-0001", 2) == 0
+    assert partition_of("job-0002", 4) == 0
+    assert partition_of("alpha", 4) == 2
+    assert partition_of("bravo", 8) == 1
+    assert partition_of("charlie", 8) == 6
+
+
+def test_partition_of_unsharded_is_zero():
+    assert partition_of("anything", 1) == 0
+    assert partition_of("anything", 0) == 0
+
+
+def test_partition_of_stable_across_processes():
+    ids = ["job-0001", "alpha", "bravo", "charlie", "x" * 64]
+    script = (
+        "from cordum_tpu.protocol.partition import partition_of\n"
+        f"print([partition_of(i, 8) for i in {ids!r}])\n"
+    )
+    out = subprocess.run([sys.executable, "-c", script], capture_output=True,
+                         text=True, timeout=60, check=True)
+    assert eval(out.stdout.strip()) == [partition_of(i, 8) for i in ids]
+
+
+def test_every_job_routes_to_exactly_one_shard():
+    for n in (2, 3, 4, 8):
+        for i in range(200):
+            jid = f"job-{i:04d}"
+            owners = [s for s in range(n) if owns(jid, s, n)]
+            assert len(owners) == 1
+            assert owners[0] == partition_of(jid, n)
+
+
+def test_partition_spread_is_reasonable():
+    counts = [0] * 4
+    for i in range(2000):
+        counts[partition_of(f"job-{i}", 4)] += 1
+    assert min(counts) > 2000 / 4 * 0.7  # no pathological skew
+
+
+# ---------------------------------------------------------------------------
+# subjects + labels
+# ---------------------------------------------------------------------------
+
+
+def test_partitioned_subjects():
+    assert subj.submit_subject(0, 1) == subj.SUBMIT
+    assert subj.submit_subject(2, 4) == "sys.job.submit.2"
+    assert subj.result_subject(1, 2) == "sys.job.result.1"
+    assert subj.cancel_subject(3, 4) == "sys.job.cancel.3"
+    assert subj.submit_subject_for("alpha", 4) == "sys.job.submit.2"
+    assert subj.submit_subject_for("alpha", 1) == subj.SUBMIT
+    assert subj.stamped_result_subject("3") == "sys.job.result.3"
+    assert subj.stamped_result_subject("") == subj.RESULT
+    for s in ("sys.job.submit.2", "sys.job.result.0", "sys.job.cancel.7"):
+        assert subj.is_durable_subject(s), s
+
+
+def test_job_hash_ignores_partition_stamp():
+    a = JobRequest(job_id="j1", topic="job.x")
+    b = JobRequest(job_id="j1", topic="job.x", labels={LABEL_PARTITION: "3"})
+    assert job_hash(a) == job_hash(b)
+
+
+def test_worker_result_subject_echoes_partition():
+    stamped = JobRequest(job_id="j", topic="t", labels={LABEL_PARTITION: "2"})
+    plain = JobRequest(job_id="j", topic="t")
+    assert Worker._result_subject(stamped) == "sys.job.result.2"
+    assert Worker._result_subject(plain) == subj.RESULT
+
+
+# ---------------------------------------------------------------------------
+# sharded engine cluster helpers
+# ---------------------------------------------------------------------------
+
+
+async def _all_succeeded(js: JobStore, jobs: list) -> bool:
+    for j in jobs:
+        if await js.get_state(j) != "SUCCEEDED":
+            return False
+    return True
+
+
+def _mk_engine(bus, kv, *, index: int, count: int) -> Engine:
+    kernel = SafetyKernel(
+        policy_doc={"tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}}
+    )
+    reg = WorkerRegistry()
+    pc = parse_pool_config(
+        {"topics": {"job.bench": "bench"}, "pools": {"bench": {"requires": []}}}
+    )
+    eng = Engine(
+        bus=bus, job_store=JobStore(kv), safety=SafetyClient(kernel.check),
+        strategy=LeastLoadedStrategy(reg, pc), registry=reg,
+        instance_id=f"shard-{index}", shard_index=index, shard_count=count,
+    )
+    reg.update(Heartbeat(worker_id="w1", pool="bench", max_parallel_jobs=1 << 30))
+    return eng
+
+
+async def _attach_worker(bus):
+    async def worker_handler(subject, pkt):
+        req = pkt.job_request
+        await bus.publish(
+            subj.stamped_result_subject((req.labels or {}).get(LABEL_PARTITION, "")),
+            BusPacket.wrap(
+                JobResult(job_id=req.job_id, status="SUCCEEDED", worker_id="w1"),
+                sender_id="w1",
+            ),
+        )
+
+    await bus.subscribe(subj.direct_subject("w1"), worker_handler, queue="w")
+
+
+async def _run_cluster(shard_count: int, job_ids: list[str], *, stamped: bool = True):
+    """Run a full submit→result pass over `shard_count` engine shards on one
+    loopback bus + shared KV; returns {job_id: (state, [event names])}."""
+    kv = MemoryKV()
+    bus = LoopbackBus()
+    engines = [_mk_engine(bus, kv, index=i, count=shard_count) for i in range(shard_count)]
+    for eng in engines:
+        await eng.start()
+    await _attach_worker(bus)
+    for jid in job_ids:
+        subject = (subj.submit_subject_for(jid, shard_count) if stamped else subj.SUBMIT)
+        await bus.publish(
+            subject,
+            BusPacket.wrap(JobRequest(job_id=jid, topic="job.bench",
+                                      tenant_id="default"), sender_id="t"),
+        )
+    js = JobStore(kv)
+    for _ in range(2000):
+        await bus.drain()
+        states = [await js.get_state(j) for j in job_ids]
+        if all(s == "SUCCEEDED" for s in states):
+            break
+        await asyncio.sleep(0.005)
+    out = {}
+    for jid in job_ids:
+        events = [e["event"] for e in await js.events(jid)]
+        out[jid] = (await js.get_state(jid), events)
+    for eng in engines:
+        await eng.stop()
+    await bus.close()
+    return out, engines
+
+
+async def test_two_shard_run_matches_single_shard():
+    """Satellite: a 2-shard engine run lands the same final states and
+    event logs as a 1-shard run over the same submit set."""
+    jobs = [f"eq-{i}" for i in range(24)]
+    single, _ = await _run_cluster(1, jobs)
+    double, engines = await _run_cluster(2, jobs)
+    assert single == double
+    assert all(s == "SUCCEEDED" for s, _ in double.values())
+    # both shards actually scheduled work (ownership split, no cross-locks)
+    per_shard = [e.metrics.shard_scheduled.value(shard=str(e.shard_index)) for e in engines]
+    assert all(v > 0 for v in per_shard), per_shard
+    assert sum(per_shard) == len(jobs)
+
+
+async def test_unstamped_submits_are_forwarded_to_owner():
+    jobs = [f"fw-{i}" for i in range(16)]
+    results, engines = await _run_cluster(2, jobs, stamped=False)
+    assert all(s == "SUCCEEDED" for s, _ in results.values())
+    forwarded = sum(
+        e.metrics.shard_forwarded.value(kind="submit", shard=str(e.shard_index))
+        for e in engines
+    )
+    assert forwarded > 0  # round-robin guarantees some landed on non-owners
+
+
+async def test_dead_shard_jobs_stay_pending_and_recover_on_restart():
+    """Degraded mode: with shard 1 stopped, shard-0 jobs still complete and
+    shard-1 jobs park in PENDING (no silent loss, no bogus terminal state);
+    a restarted shard 1 picks them up on replay."""
+    kv = MemoryKV()
+    bus = LoopbackBus()
+    js = JobStore(kv)
+    eng0 = _mk_engine(bus, kv, index=0, count=2)
+    await eng0.start()  # shard 1 is down
+    await _attach_worker(bus)
+
+    jobs = [f"dg-{i}" for i in range(24)]
+    live = [j for j in jobs if partition_of(j, 2) == 0]
+    dead = [j for j in jobs if partition_of(j, 2) == 1]
+    assert live and dead  # both partitions represented
+    for jid in jobs:
+        # gateway-style submit: PENDING meta + request blob precede the bus
+        # publish, so an unowned job is durably visible, not lost
+        await js.set_state(jid, JobState.PENDING,
+                           fields={"topic": "job.bench"}, event="submit")
+        await js.put_request(JobRequest(job_id=jid, topic="job.bench",
+                                        tenant_id="default"))
+        await bus.publish(
+            subj.submit_subject_for(jid, 2),
+            BusPacket.wrap(JobRequest(job_id=jid, topic="job.bench",
+                                      tenant_id="default"), sender_id="t"),
+        )
+    for _ in range(2000):
+        await bus.drain()
+        if await _all_succeeded(js, live):
+            break
+        await asyncio.sleep(0.005)
+    for jid in live:
+        assert await js.get_state(jid) == "SUCCEEDED"
+    for jid in dead:
+        # schedulable-after-restart: still PENDING, request blob intact
+        assert await js.get_state(jid) == "PENDING"
+        assert await js.get_request(jid) is not None
+
+    # the LIVE shard's replayer must not steal the dead shard's jobs …
+    from cordum_tpu.controlplane.scheduler.reconciler import PendingReplayer
+    from cordum_tpu.infra.config import Timeouts
+
+    assert await PendingReplayer(eng0, js, Timeouts(pending_replay_s=0.0)).run_once() == 0
+    for jid in dead:
+        assert await js.get_state(jid) == "PENDING"
+
+    # … while a RESTARTED owner shard replays them to completion
+    eng1 = _mk_engine(bus, kv, index=1, count=2)
+    await eng1.start()
+    await PendingReplayer(eng1, js, Timeouts(pending_replay_s=0.0)).run_once()
+    for _ in range(2000):
+        await bus.drain()
+        if await _all_succeeded(js, dead):
+            break
+        await asyncio.sleep(0.005)
+    for jid in dead:
+        assert await js.get_state(jid) == "SUCCEEDED"
+    await eng0.stop()
+    await eng1.stop()
+    await bus.close()
+
+
+async def test_progress_recorded_once_across_shards():
+    """Progress fans out to every shard; only the owner appends the event."""
+    from cordum_tpu.protocol.types import JobProgress
+
+    kv = MemoryKV()
+    bus = LoopbackBus()
+    engines = [_mk_engine(bus, kv, index=i, count=2) for i in range(2)]
+    for e in engines:
+        await e.start()
+    jid = "prog-1"
+    await bus.publish(
+        subj.PROGRESS,
+        BusPacket.wrap(JobProgress(job_id=jid, percent=50.0, message="half"),
+                       sender_id="w1"),
+    )
+    await bus.drain()
+    events = await JobStore(kv).events(jid)
+    assert len([e for e in events if e["event"] == "progress"]) == 1
+    for e in engines:
+        await e.stop()
+    await bus.close()
+
+
+# ---------------------------------------------------------------------------
+# partitioned KV
+# ---------------------------------------------------------------------------
+
+
+async def test_partitioned_kv_job_keys_colocate():
+    parts = [MemoryKV(), MemoryKV()]
+    kv = PartitionedKV(parts)
+    js = JobStore(kv)
+    jid = "colo-1"
+    await js.set_state(jid, JobState.PENDING, fields={"topic": "t"}, event="submit")
+    await js.put_request(JobRequest(job_id=jid, topic="t"))
+    home = partition_of(jid, 2)
+    # meta, request, events all live on the job's home partition only
+    for key in (f"job:meta:{jid}", f"job:request:{jid}", f"job:events:{jid}"):
+        assert await parts[home].version(key) > 0, key
+        assert await parts[1 - home].version(key) == 0, key
+    # reads through the facade see them
+    assert (await js.get_meta(jid)).get("topic") == "t"
+    assert await js.get_request(jid) is not None
+
+
+async def test_partitioned_kv_merged_indexes():
+    kv = PartitionedKV([MemoryKV(), MemoryKV()])
+    js = JobStore(kv)
+    jobs = [f"idx-{i}" for i in range(12)]
+    for jid in jobs:
+        await js.set_state(jid, JobState.PENDING, fields={"topic": "t"}, event="s")
+    # state index + recent merge across partitions
+    assert sorted(await js.list_by_state("PENDING", 100)) == sorted(jobs)
+    assert set(await js.list_recent(100)) == set(jobs)
+    assert await kv.zcard("job:index:PENDING") == len(jobs)
+    # transitions move ids between the merged indexes
+    for jid in jobs[:5]:
+        await js.set_state(jid, JobState.CANCELLED, event="cancel")
+    assert sorted(await js.list_by_state("CANCELLED", 100)) == sorted(jobs[:5])
+    assert len(await js.list_by_state("PENDING", 100)) == len(jobs) - 5
+
+
+async def test_partitioned_kv_trace_and_tenant_sets():
+    kv = PartitionedKV([MemoryKV(), MemoryKV(), MemoryKV()])
+    js = JobStore(kv)
+    jobs = [f"tr-{i}" for i in range(9)]
+    for jid in jobs:
+        await js.add_to_trace("trace-A", jid)
+        await js.tenant_active_add("acme", jid)
+    assert await js.trace("trace-A") == set(jobs)
+    assert await js.tenant_active_count("acme") == len(jobs)
+    for jid in jobs:
+        await js.tenant_active_remove("acme", jid)
+    assert await js.tenant_active_count("acme") == 0
+
+
+async def test_partitioned_kv_global_delete_broadcasts():
+    kv = PartitionedKV([MemoryKV(), MemoryKV()])
+    for i in range(8):
+        await kv.zadd("job:recent", f"jr-{i}", float(i))
+    assert await kv.zcard("job:recent") == 8
+    await kv.delete("job:recent")
+    assert await kv.zcard("job:recent") == 0
+
+
+async def test_partitioned_kv_pipe_is_atomic_on_home_partition():
+    parts = [MemoryKV(), MemoryKV()]
+    kv = PartitionedKV(parts)
+    jid = "pipe-1"
+    key = f"job:meta:{jid}"
+    ok, versions = await kv.pipe_execute(
+        {key: 0},
+        [("hset", key, {"state": b"PENDING"}),
+         ("zadd", "job:index:PENDING", jid, 1.0)],
+    )
+    assert ok and versions[key] > 0
+    home = partition_of(jid, 2)
+    assert await parts[home].zcard("job:index:PENDING") == 1
+    assert await parts[1 - home].zcard("job:index:PENDING") == 0
+    # conflicting watch rejects without touching state
+    ok2, _ = await kv.pipe_execute({key: 0}, [("hset", key, {"state": b"X"})])
+    assert not ok2
+    assert (await kv.hgetall(key))["state"] == b"PENDING"
+
+
+# ---------------------------------------------------------------------------
+# partitioned statebus over live TCP (+ coalesced wire path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.statebus
+async def test_partitioned_statebus_end_to_end():
+    srvs = [StateBusServer(port=0), StateBusServer(port=0)]
+    for s in srvs:
+        await s.start()
+    urls = ",".join(f"statebus://127.0.0.1:{s.port}" for s in srvs)
+    kv, bus, grp = await connect_partitioned(urls)
+    try:
+        assert isinstance(kv, PartitionedKV) and isinstance(bus, PartitionedBus)
+        assert await kv.ping() and await bus.ping()
+        # keyspace routing round-trips through real wire partitions
+        for i in range(10):
+            await kv.set(f"wire-{i}", str(i).encode())
+        for i in range(10):
+            assert await kv.get(f"wire-{i}") == str(i).encode()
+        assert sorted(await kv.keys("wire-")) == sorted(f"wire-{i}" for i in range(10))
+        # concrete-subject pub/sub with a queue group + wildcard fanout
+        got: list[tuple[str, str]] = []
+        done = asyncio.Event()
+
+        async def on_concrete(subject, pkt):
+            got.append(("q", subject))
+            if len(got) >= 4:
+                done.set()
+
+        async def on_wild(subject, pkt):
+            got.append(("w", subject))
+            if len(got) >= 4:
+                done.set()
+
+        await bus.subscribe("sys.job.submit.0", on_concrete, queue="g")
+        await bus.subscribe("sys.job.submit.>", on_wild)
+        for jid in ("a", "b"):
+            await bus.publish(
+                "sys.job.submit.0",
+                BusPacket.wrap(JobRequest(job_id=jid, topic="t"), sender_id="t"),
+            )
+        await asyncio.wait_for(done.wait(), 10)
+        assert len([g for g in got if g[0] == "q"]) == 2
+        assert len([g for g in got if g[0] == "w"]) == 2
+        # the coalescing writer actually batched frames server-side
+        coalesced = 0
+        for s in srvs:
+            text = s.metrics.render()
+            for line in text.splitlines():
+                if line.startswith("cordum_statebus_coalesced_batch_count"):
+                    coalesced += float(line.rsplit(" ", 1)[1])
+        assert coalesced > 0
+    finally:
+        await grp.close()
+        for s in srvs:
+            await s.stop()
+
+
+@pytest.mark.statebus
+async def test_sharded_engines_over_partitioned_statebus():
+    """Two engine shards + a worker over two real statebus partitions: the
+    full wire topology of the sharded bench, in miniature."""
+    srvs = [StateBusServer(port=0), StateBusServer(port=0)]
+    for s in srvs:
+        await s.start()
+    urls = ",".join(f"statebus://127.0.0.1:{s.port}" for s in srvs)
+    conns = []
+    engines = []
+    try:
+        for i in range(2):
+            kv, bus, grp = await connect_partitioned(urls)
+            conns.append(grp)
+            eng = _mk_engine(bus, kv, index=i, count=2)
+            engines.append(eng)
+            await eng.start()
+        wkv, wbus, wgrp = await connect_partitioned(urls)
+        conns.append(wgrp)
+        await _attach_worker(wbus)
+        jobs = [f"sb-{i}" for i in range(16)]
+        for jid in jobs:
+            await wbus.publish(
+                subj.submit_subject_for(jid, 2),
+                BusPacket.wrap(JobRequest(job_id=jid, topic="job.bench",
+                                          tenant_id="default"), sender_id="t"),
+            )
+        js = JobStore(wkv)
+        for _ in range(400):
+            if await _all_succeeded(js, jobs):
+                break
+            await asyncio.sleep(0.025)
+        assert await _all_succeeded(js, jobs)
+        split = [e.metrics.shard_scheduled.value(shard=str(e.shard_index)) for e in engines]
+        assert sum(split) == len(jobs) and all(v > 0 for v in split), split
+    finally:
+        for eng in engines:
+            await eng.stop()
+        for grp in conns:
+            await grp.close()
+        for s in srvs:
+            await s.stop()

@@ -50,22 +50,35 @@ def log(msg: str) -> None:
 def spawn_stack(logdir: str) -> list[subprocess.Popen]:
     base_env = dict(os.environ)
     base_env.update({
-        "CORDUM_STATEBUS_URL": f"statebus://127.0.0.1:{STATEBUS_PORT}",
+        # sharded control plane: 2 statebus keyspace partitions (one process,
+        # consecutive ports) × 2 scheduler shards — the ISSUE 5 smoke topology
+        "CORDUM_STATEBUS_URL": (
+            f"statebus://127.0.0.1:{STATEBUS_PORT},"
+            f"statebus://127.0.0.1:{STATEBUS_PORT + 1}"
+        ),
+        "CORDUM_SCHEDULER_SHARDS": "2",
         "PYTHONPATH": REPO + os.pathsep + base_env.get("PYTHONPATH", ""),
         "CORDUM_FORCE_CPU": "1",
         "JAX_PLATFORMS": "cpu",
     })
+    sched_env = {
+        "SAFETY_KERNEL_ADDR": f"http://127.0.0.1:{KERNEL_PORT}",
+        "POOL_CONFIG_PATH": os.path.join(logdir, "pools.yaml"),
+        "TIMEOUT_CONFIG_PATH": os.path.join(logdir, "timeouts.yaml"),
+        "SCHEDULER_SHARD_COUNT": "2",
+    }
     services = [
         ("statebus", "cordum_tpu.cmd.statebus",
          {"STATEBUS_PORT": str(STATEBUS_PORT),
+          "STATEBUS_PARTITIONS": "2",
           "STATEBUS_AOF": os.path.join(logdir, "state.aof")}),
         ("kernel", "cordum_tpu.cmd.safety_kernel",
          {"SAFETY_KERNEL_PORT": str(KERNEL_PORT),
           "SAFETY_POLICY_PATH": os.path.join(logdir, "safety.yaml")}),
-        ("scheduler", "cordum_tpu.cmd.scheduler",
-         {"SAFETY_KERNEL_ADDR": f"http://127.0.0.1:{KERNEL_PORT}",
-          "POOL_CONFIG_PATH": os.path.join(logdir, "pools.yaml"),
-          "TIMEOUT_CONFIG_PATH": os.path.join(logdir, "timeouts.yaml")}),
+        ("scheduler-0", "cordum_tpu.cmd.scheduler",
+         {**sched_env, "SCHEDULER_SHARD_INDEX": "0"}),
+        ("scheduler-1", "cordum_tpu.cmd.scheduler",
+         {**sched_env, "SCHEDULER_SHARD_INDEX": "1"}),
         ("wfengine", "cordum_tpu.cmd.workflow_engine", {}),
         ("gateway", "cordum_tpu.cmd.gateway",
          {"GATEWAY_HTTP_ADDR": f"127.0.0.1:{GATEWAY_PORT}",
@@ -91,7 +104,8 @@ def spawn_stack(logdir: str) -> list[subprocess.Popen]:
         )
     with open(os.path.join(logdir, "timeouts.yaml"), "w") as f:
         f.write("reconciler:\n  dispatch_timeout_seconds: 60\n"
-                "  running_timeout_seconds: 120\n  scan_interval_seconds: 2\n")
+                "  running_timeout_seconds: 120\n  scan_interval_seconds: 2\n"
+                "  pending_replay_seconds: 4\n")
     with open(os.path.join(logdir, "safety.yaml"), "w") as f:
         f.write("default_tenant: default\ntenants:\n  default:\n"
                 "    allow_topics: [\"job.*\", \"job.>\"]\nrules: []\n")
