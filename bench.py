@@ -580,6 +580,89 @@ async def bench_sharded(shards: int, partitions: int, n_jobs: int) -> dict:
                     p.kill()
 
 
+def bench_profile() -> dict:
+    """Per-layer timing breakdown (``--profile``; also emitted by --smoke):
+    microbenchmarks of the four layers the 1×1 hot path decomposes into —
+    routing, codec, selection, commit — so a future throughput regression
+    is attributable to a layer straight from the JSON artifact (ISSUE 6).
+    All numbers are microseconds per operation."""
+    import random
+
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.codec import pack_record, unpack_record
+    from cordum_tpu.infra.jobstore import JobStore, MetaSnapshot
+    from cordum_tpu.infra.kv import MemoryKV
+    from cordum_tpu.infra.registry import WorkerRegistry
+    from cordum_tpu.infra.statebus import PartitionedKV
+    from cordum_tpu.protocol.partition import partition_of
+    from cordum_tpu.protocol.types import (
+        BusPacket, Heartbeat, JobRequest, JobState,
+    )
+
+    def us_per(fn, n: int) -> float:
+        fn()  # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n * 1e6
+
+    out: dict = {}
+
+    # routing: keyspace hash + the 1×1 identity collapse
+    out["routing_partition_of_us"] = round(
+        us_per(lambda: partition_of("job-abcdef-123456", 8), 20000), 3)
+    out["routing_unsharded_collapsed"] = PartitionedKV([MemoryKV()]).__class__ is MemoryKV
+
+    # codec: envelope encode/decode, lazy payload, cached re-encode, records
+    req = JobRequest(job_id="prof-1", topic="job.bench", tenant_id="default",
+                     labels={"k": "v"}, env={"A": "B"})
+    out["codec_encode_us"] = round(
+        us_per(lambda: BusPacket.wrap(req, sender_id="prof").to_wire(), 5000), 3)
+    wire = BusPacket.wrap(req, sender_id="prof").to_wire()
+    out["codec_decode_envelope_us"] = round(
+        us_per(lambda: BusPacket.from_wire(wire), 5000), 3)
+    out["codec_decode_payload_us"] = round(
+        us_per(lambda: BusPacket.from_wire(wire).job_request, 5000), 3)
+    out["codec_reencode_cached_us"] = round(
+        us_per(lambda: BusPacket.from_wire(wire).to_wire(), 5000), 3)
+    rec = {"ts_us": 1, "state": JobState.RUNNING.value,
+           "prev": JobState.DISPATCHED.value, "event": "running"}
+    packed = pack_record(rec)
+    out["codec_record_pack_us"] = round(us_per(lambda: pack_record(rec), 20000), 3)
+    out["codec_record_unpack_us"] = round(
+        us_per(lambda: unpack_record(packed), 20000), 3)
+
+    # selection: the strategy pick (native scan when available)
+    rng = random.Random(9)
+    reg = WorkerRegistry()
+    for i in range(100):
+        reg.update(Heartbeat(worker_id=f"w{i:03d}", pool="bench",
+                             active_jobs=rng.randint(0, 4), max_parallel_jobs=16))
+    pc = parse_pool_config(
+        {"topics": {"job.bench": "bench"}, "pools": {"bench": {"requires": []}}})
+    strat = LeastLoadedStrategy(reg, pc)
+    sreq = JobRequest(job_id="prof", topic="job.bench")
+    out["selection_pick_us"] = round(us_per(lambda: strat.pick_subject(sreq), 10000), 3)
+
+    # commit: a grouped pipelined transition chain on MemoryKV
+    kv = MemoryKV()
+    js = JobStore(kv)
+    ops, _, _ = js.build_chain_ops(
+        "prof-job", MetaSnapshot(), [(JobState.PENDING, {"topic": "job.bench"}, "submit")]
+    )
+
+    async def commit_loop(n: int) -> float:
+        await kv.pipe_execute({}, ops)  # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            await kv.pipe_execute({}, ops)
+        return (time.perf_counter() - t0) / n * 1e6
+
+    out["commit_pipe_us"] = round(asyncio.run(commit_loop(5000)), 3)
+    return out
+
+
 def bench_selection() -> dict:
     """Worker-selection throughput at 1000 workers (reference analogue:
     18,234 selections/s, BENCHMARKS.md:131)."""
@@ -631,10 +714,43 @@ def _jax_child(device: str) -> None:
 
     # Backend-discovery watchdog (the BENCH_r04/r05 `child rc=1` fix): on
     # hosts where libtpu is installed but no TPU is grantable, jax.devices()
-    # HANGS instead of raising, and the long faulthandler watchdog used to
-    # kill the child with rc=1 — violating the clean-skip contract.  A tpu
-    # probe that doesn't finish inside TPU_PROBE_TIMEOUT_S is a skip
-    # (exit 0, {"skipped": ...}); a hung CPU probe is a real failure.
+    # HANGS instead of raising — and it hangs inside C init WITHOUT releasing
+    # the GIL, so an in-process watchdog thread (the original PR-5 fix) never
+    # gets to run.  The tpu probe therefore runs in a THROWAWAY GRANDCHILD
+    # process this child can kill from outside the GIL: a probe that doesn't
+    # finish inside TPU_PROBE_TIMEOUT_S, crashes, or reports a non-tpu
+    # backend is a clean skip (exit 0, {"skipped": ...}).  Only a probe that
+    # confirms a real TPU lets this process touch jax at all.
+    if device == "tpu":
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, json; print(json.dumps(jax.devices()[0].platform))"],
+                capture_output=True, text=True, timeout=TPU_PROBE_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"skipped": "no tpu",
+                              "detail": "backend probe timed out after "
+                                        f"{TPU_PROBE_TIMEOUT_S:.0f}s (TPU grant unavailable?)"}),
+                  flush=True)
+            return
+        platform = ""
+        if probe.returncode == 0:
+            lines = [ln for ln in probe.stdout.strip().splitlines() if ln]
+            try:
+                platform = json.loads(lines[-1]) if lines else ""
+            except ValueError:
+                platform = ""
+        if platform != "tpu":
+            detail = (f"jax backend is {platform!r}" if probe.returncode == 0
+                      else f"probe rc={probe.returncode}: {(probe.stderr or '')[-200:]}")
+            print(json.dumps({"skipped": "no tpu", "detail": detail}), flush=True)
+            return
+
+    # second line of defense: a probe-confirmed backend that still wedges in
+    # THIS process trips the event-based watchdog (kept for the case where
+    # the grant vanishes between probe and init — here the hang does release
+    # the GIL once real compilation work is underway)
     probe_done = threading.Event()
 
     def _probe_watchdog() -> None:
@@ -933,6 +1049,7 @@ def main() -> None:
         _shard_child(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
         return
     smoke = "--smoke" in sys.argv
+    profile = "--profile" in sys.argv or smoke  # smoke ships the breakdown in CI
     if smoke:
         # CI sanity mode: small sizes, cpu-only compute child, same JSON shape
         N_JOBS = min(N_JOBS, 400)
@@ -951,6 +1068,7 @@ def main() -> None:
     sharded = asyncio.run(bench_sharded(shards, SB_PARTITIONS, sh_jobs))
     sharded_single = asyncio.run(bench_sharded(1, 1, sh_jobs))
     sel = bench_selection()
+    prof = bench_profile() if profile else None
     jx = bench_jax(smoke=smoke)
     out = {
         "metric": "scheduled_jobs_per_sec",
@@ -1009,6 +1127,9 @@ def main() -> None:
     }
     if smoke:
         out["smoke"] = True
+    if prof is not None:
+        # per-layer µs/op breakdown: routing / codec / selection / commit
+        out["profile"] = prof
     for k in ("fallback_device", "tpu_skipped", "tpu_embed_error",
               "tpu_model_error", "tpu_batched_error"):
         if k in jx:

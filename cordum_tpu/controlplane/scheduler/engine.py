@@ -18,24 +18,29 @@ redesigned for asyncio + at-least-once redelivery:
   * strategy pick → SCHEDULED → publish job packet → DISPATCHED → RUNNING
   * ``handleJobResult``: terminal state + result_ptr, DLQ on failure,
     terminal-state short-circuit for idempotency under redelivery
+  * tick batching (ISSUE 6): submits landing in one event-loop tick drain
+    into ONE selection pass + grouped pipelined commits; anything off the
+    common path (redelivery, non-ALLOW decisions, tenant limits) falls back
+    to the per-job path above, so the batch is a pure fast path
 """
 from __future__ import annotations
 
 import asyncio
-import json
+import contextlib
 import time
-from typing import Optional
+from typing import AsyncIterator, Optional
 
-from ...infra import logging as logx
+from ...infra import codec, logging as logx
 from ...infra.bus import Bus, RetryAfter
 from ...infra.configsvc import ConfigService
-from ...infra.jobstore import JobStore, MetaSnapshot, SafetyDecisionRecord
+from ...infra.jobstore import JobStore, MetaSnapshot, SafetyDecisionRecord, meta_key
 from ...infra.metrics import Metrics
 from ...infra.registry import WorkerRegistry
-from ...obs.tracer import Tracer
+from ...obs.tracer import Tracer, current_trace_context
 from ...protocol import subjects as subj
 from ...protocol.jobhash import job_hash
 from ...protocol.partition import partition_of
+from ...utils.eager import eager_gather
 from ...utils.ids import now_us
 from ...protocol.types import (
     BusPacket,
@@ -65,6 +70,58 @@ _INFLIGHT_STATES = (
 )
 
 
+def _owns_everything(job_id: str) -> bool:
+    """Identity ownership for the unsharded engine — bound at construction
+    so the 1×1 hot path never hashes a job id (ISSUE 6)."""
+    return True
+
+
+class _SubmitItem:
+    """One submit riding a scheduler tick batch."""
+
+    __slots__ = (
+        "req", "trace_id", "parent_span_id", "fut",
+        "snap", "pending", "resp", "sched_sp", "target",
+    )
+
+    def __init__(self, req: JobRequest, trace_id: str, parent_span_id: str,
+                 fut: "asyncio.Future[None]") -> None:
+        self.req = req
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.fut = fut
+        self.snap: Optional[MetaSnapshot] = None
+        self.pending: dict[str, str] = {}
+        self.resp = None
+        self.sched_sp = None
+        self.target = ""
+
+    @property
+    def job_id(self) -> str:
+        return self.req.job_id
+
+
+class _ResultItem:
+    """One job result riding a scheduler tick batch."""
+
+    __slots__ = ("res", "trace_id", "parent_span_id", "fut", "snap",
+                 "sched_sp", "state")
+
+    def __init__(self, res: JobResult, trace_id: str, parent_span_id: str,
+                 fut: "asyncio.Future[None]") -> None:
+        self.res = res
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.fut = fut
+        self.snap: Optional[MetaSnapshot] = None
+        self.sched_sp = None  # the per-item "result" span (see _fail_item)
+        self.state: Optional[JobState] = None
+
+    @property
+    def job_id(self) -> str:
+        return self.res.job_id
+
+
 class Engine:
     def __init__(
         self,
@@ -83,6 +140,7 @@ class Engine:
         submit_concurrency: int = DEFAULT_SUBMIT_CONCURRENCY,
         shard_index: int = 0,
         shard_count: int = 1,
+        batch_ticks: bool = True,
     ):
         self.bus = bus
         self.tracer = tracer or Tracer("scheduler", bus)
@@ -110,8 +168,25 @@ class Engine:
         self.shard_index = shard_index
         self.shard_count = max(1, shard_count)
         self._shard_label = str(shard_index)
+        if self.shard_count == 1:
+            # 1×1 specialization: ownership and partition stamping collapse
+            # to identity at construction — no per-message branch or crc32
+            self.owns = _owns_everything  # type: ignore[method-assign]
+            self._stamp_partition = self._stamp_noop  # type: ignore[method-assign]
         self._inflight = 0  # submit backlog gauge (cordum_shard_partition_queue_depth)
         self._subs = []
+        # tick batching (ISSUE 6): submits arriving in one event-loop tick
+        # drain together; grouped commits need co-committable keys, which
+        # kv.pipe_group answers per key
+        self.batch_ticks = batch_ticks
+        self._submit_q: list[_SubmitItem] = []
+        self._result_q: list[_ResultItem] = []
+        self._submit_wake = asyncio.Event()
+        self._drain_task: Optional[asyncio.Task] = None
+        # dispatch-time snapshot cache: the RUNNING commit's post-commit
+        # MetaSnapshot, so the result path needs ZERO reads in the common
+        # case (a conflict — e.g. a cancel racing the result — re-reads)
+        self._snap_cache: dict[str, MetaSnapshot] = {}
         # kv round-trip accounting (cordum_kv_roundtrips_total{op}) for the
         # store this engine drives — the bench's kv_roundtrips_per_job source
         job_store.kv.bind_metrics(self.metrics)
@@ -143,11 +218,26 @@ class Engine:
                     subj.cancel_subject(self.shard_index, self.shard_count),
                     self._on_cancel, queue=q),
             ]
+        if self.batch_ticks and self._drain_task is None:
+            self._drain_task = asyncio.ensure_future(self._submit_drain_loop())
 
     async def stop(self) -> None:
         for s in self._subs:
             s.unsubscribe()
         self._subs = []
+        if self._drain_task is not None:
+            self._drain_task.cancel()
+            try:
+                await self._drain_task
+            except asyncio.CancelledError:
+                pass
+            self._drain_task = None
+        for it in [*self._submit_q, *self._result_q]:
+            if not it.fut.done():
+                it.fut.cancel()
+        self._submit_q = []
+        self._result_q = []
+        self._snap_cache.clear()
 
     # ------------------------------------------------------------------
     def owns(self, job_id: str) -> bool:
@@ -203,13 +293,463 @@ class Engine:
         self._inflight += 1
         self.metrics.shard_queue_depth.set(float(self._inflight), shard=self._shard_label)
         try:
-            async with self._sem:
-                await self.handle_job_request(
-                    req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
+            if self.batch_ticks and self._drain_task is not None:
+                # enqueue for the tick batch; the await preserves per-message
+                # semantics exactly (a RetryAfter raised while processing the
+                # batch propagates to THIS delivery and drives redelivery)
+                fut: asyncio.Future[None] = asyncio.get_running_loop().create_future()
+                self._submit_q.append(
+                    _SubmitItem(req, pkt.trace_id, pkt.span_id, fut)
                 )
+                self._submit_wake.set()
+                await fut
+            else:
+                async with self._sem:
+                    await self.handle_job_request(
+                        req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
+                    )
         finally:
             self._inflight -= 1
             self.metrics.shard_queue_depth.set(float(self._inflight), shard=self._shard_label)
+
+    # ------------------------------------------------------------------
+    # tick batching (ISSUE 6): the submit fast path
+    # ------------------------------------------------------------------
+    async def _submit_drain_loop(self) -> None:
+        """Drain every submit that accumulated during the last event-loop
+        tick and process them as ONE batch (mirror of the statebus write
+        coalescer).  The loop is single-flight: submits arriving while a
+        batch is in progress form the next batch."""
+        while True:
+            await self._submit_wake.wait()
+            self._submit_wake.clear()
+            batch = self._submit_q[: self.submit_concurrency]
+            del self._submit_q[: self.submit_concurrency]
+            rbatch = self._result_q[: self.submit_concurrency]
+            del self._result_q[: self.submit_concurrency]
+            if self._submit_q or self._result_q:
+                self._submit_wake.set()
+            for items, process in (
+                (batch, self._process_submit_batch),
+                (rbatch, self._process_result_batch),
+            ):
+                if not items:
+                    continue
+                try:
+                    await process(items)
+                except asyncio.CancelledError:
+                    for it in items:
+                        if not it.fut.done():
+                            it.fut.cancel()
+                    raise
+                except Exception as e:  # noqa: BLE001 - a batch bug must not wedge the queue
+                    logx.error("tick batch failed", err=str(e))
+                    for it in items:
+                        if not it.fut.done():
+                            it.fut.set_exception(e)
+
+    @contextlib.asynccontextmanager
+    async def _spanctx(
+        self, name: str, trace_id: str, parent_span_id: str, attrs: dict
+    ) -> AsyncIterator:
+        """Explicit-parent span (no ambient contextvar): the batched path
+        runs several jobs' spans interleaved in one task, so parenting must
+        not ride the task-local context."""
+        sp = self.tracer.begin(
+            name, trace_id=trace_id, parent_span_id=parent_span_id, attrs=attrs
+        )
+        try:
+            yield sp
+        except BaseException as e:
+            sp.attrs.setdefault("error", type(e).__name__)
+            await self.tracer.finish(sp, status="ERROR")
+            raise
+        else:
+            await self.tracer.finish(sp)
+
+    def _submit_fields(self, req: JobRequest, trace_id: str) -> dict[str, str]:
+        return {
+            "topic": req.topic,
+            "tenant_id": req.tenant_id,
+            "principal_id": req.principal_id,
+            "context_ptr": req.context_ptr,
+            "workflow_id": req.workflow_id,
+            "run_id": req.run_id,
+            "trace_id": trace_id,
+            "priority": req.priority,
+            "submitted_at_us": str(time.time_ns() // 1000),
+        }
+
+    async def _process_submit_batch(self, batch: list[_SubmitItem]) -> None:
+        self.metrics.sched_tick_batch.observe(float(len(batch)))
+        # duplicate job ids inside one tick cannot share a grouped create
+        # (their watches would collapse); dupes take the per-job path, whose
+        # lock + short-circuits already model redelivery
+        seen: set[str] = set()
+        grouped: list[_SubmitItem] = []
+        fallback: list[_SubmitItem] = []
+        for it in batch:
+            if it.req.job_id in seen:
+                fallback.append(it)
+            else:
+                seen.add(it.req.job_id)
+                grouped.append(it)
+
+        # stage 1 — grouped optimistic create: every job assumed fresh
+        # (version 0), →PENDING + request blob + trace membership folded
+        # into ONE pipe per co-committable key group.  A conflicted group
+        # means at least one job already exists → that whole group falls
+        # back to the per-job path (which re-reads and short-circuits).
+        created: list[_SubmitItem] = []
+        kv = self.job_store.kv
+        groups: dict[int, list[_SubmitItem]] = {}
+        for it in grouped:
+            groups.setdefault(kv.pipe_group(meta_key(it.req.job_id)), []).append(it)
+        for members in groups.values():
+            watches: dict[str, int] = {}
+            ops: list[tuple] = []
+            overlays: dict[str, dict[str, bytes]] = {}
+            for it in members:
+                jid = it.req.job_id
+                c_ops, overlay, _ = self.job_store.build_chain_ops(
+                    jid, MetaSnapshot(),
+                    [(JobState.PENDING, self._submit_fields(it.req, it.trace_id),
+                      "submit")],
+                )
+                watches[meta_key(jid)] = 0
+                ops.extend(c_ops)
+                ops.extend(self.job_store.put_request_ops(it.req))
+                ops.extend(self.job_store.add_to_trace_ops(it.trace_id, jid))
+                overlays[jid] = overlay
+            ok, versions = await kv.pipe_execute(watches, ops)
+            if ok:
+                for it in members:
+                    jid = it.req.job_id
+                    it.snap = MetaSnapshot(
+                        versions.get(meta_key(jid), 0), dict(overlays[jid])
+                    )
+                    self.metrics.jobs_received.inc(topic=it.req.topic)
+                created.extend(members)
+            else:
+                fallback.extend(members)
+
+        if fallback:
+            self.metrics.sched_tick_fallbacks.inc(amount=float(len(fallback)))
+            await eager_gather([self._fallback_submit(it) for it in fallback])
+        if not created:
+            return
+
+        # stage 2 — per-job config attach + policy check, gathered so the
+        # checks overlap; each runs inside its own task, so the policy-check
+        # span context stays per-job (remote kernels see correct parents)
+        await eager_gather([self._batch_pre(it) for it in created])
+
+        # stage 3 — classify: the grouped tail handles only the plain-ALLOW
+        # shape (no constraints, no tenant concurrency gate); everything
+        # else continues on the per-job decision tail
+        simple: list[_SubmitItem] = []
+        complex_: list[_SubmitItem] = []
+        for it in created:
+            if it.fut.done():
+                continue  # pre-stage already failed this item
+            resp = it.resp
+            gated = bool(self._tenant_limit(it.req) and it.req.tenant_id)
+            if resp.decision == Decision.ALLOW.value and not gated:
+                simple.append(it)
+            else:
+                complex_.append(it)
+        if complex_:
+            self.metrics.sched_tick_fallbacks.inc(amount=float(len(complex_)))
+            await eager_gather([self._complex_tail(it) for it in complex_])
+        if simple:
+            await self._batch_dispatch(simple)
+
+    async def _fallback_submit(self, it: _SubmitItem) -> None:
+        """Per-job slow path for batch members that aren't fresh creates;
+        funnels the outcome (including RetryAfter) into the item's future."""
+        try:
+            async with self._sem:
+                await self.handle_job_request(
+                    it.req, trace_id=it.trace_id, parent_span_id=it.parent_span_id
+                )
+        except BaseException as e:
+            if not it.fut.done():
+                it.fut.set_exception(e)
+        else:
+            if not it.fut.done():
+                it.fut.set_result(None)
+
+    async def _batch_pre(self, it: _SubmitItem) -> None:
+        req = it.req
+        it.sched_sp = self.tracer.begin(
+            "schedule", trace_id=it.trace_id, parent_span_id=it.parent_span_id,
+            attrs={"job_id": req.job_id, "topic": req.topic},
+        )
+        try:
+            cfg_hash = await self._attach_effective_config(req)
+            if cfg_hash:
+                it.pending["config_hash"] = cfg_hash
+            async with self.tracer.span(
+                "policy-check", trace_id=it.sched_sp.trace_id,
+                parent_span_id=it.sched_sp.span_id, attrs={"job_id": req.job_id},
+            ) as polsp:
+                it.resp = await self._check_safety(req)
+                polsp.attrs["decision"] = it.resp.decision
+        except BaseException as e:
+            await self._fail_item(it, e)
+
+    async def _complex_tail(self, it: _SubmitItem) -> None:
+        """Non-ALLOW / gated decisions: reuse the per-job decision tail."""
+        try:
+            await self._post_decision(
+                it.req, it.resp, snap=it.snap, pending_fields=it.pending,
+                trace_id=it.sched_sp.trace_id, parent_span_id=it.sched_sp.span_id,
+            )
+        except BaseException as e:
+            await self._fail_item(it, e)
+        else:
+            await self._finish_item(it)
+
+    async def _fail_item(self, it: _SubmitItem, e: BaseException) -> None:
+        if it.sched_sp is not None:
+            it.sched_sp.attrs.setdefault("error", type(e).__name__)
+            await self.tracer.finish(it.sched_sp, status="ERROR")
+            it.sched_sp = None
+        if not it.fut.done():
+            it.fut.set_exception(e)
+
+    async def _finish_item(self, it: _SubmitItem) -> None:
+        if it.sched_sp is not None:
+            await self.tracer.finish(it.sched_sp)
+            it.sched_sp = None
+        if not it.fut.done():
+            it.fut.set_result(None)
+
+    async def _group_chain(self, items: list, steps_for, extra_for=None) -> None:
+        """Commit one transition chain per item, folding co-committable items
+        into ONE grouped pipe; a conflicted group degrades to per-job
+        ``apply_chain`` (which re-reads and retries).  Per-item failures
+        (e.g. a cancel racing the batch → IllegalTransition) fail only that
+        item via its future."""
+        kv = self.job_store.kv
+        groups: dict[int, list] = {}
+        for it in items:
+            groups.setdefault(kv.pipe_group(meta_key(it.job_id)), []).append(it)
+        for members in groups.values():
+            watches: dict[str, int] = {}
+            ops: list[tuple] = []
+            overlays: dict[str, dict[str, bytes]] = {}
+            try:
+                for it in members:
+                    jid = it.job_id
+                    c_ops, overlay, _ = self.job_store.build_chain_ops(
+                        jid, it.snap, steps_for(it)
+                    )
+                    if extra_for is not None:
+                        c_ops = [*c_ops, *extra_for(it)]
+                    watches[meta_key(jid)] = it.snap.version
+                    ops.extend(c_ops)
+                    overlays[jid] = overlay
+                ok, versions = await kv.pipe_execute(watches, ops)
+            except BaseException:
+                ok = False
+            if ok:
+                for it in members:
+                    jid = it.job_id
+                    merged = dict(it.snap.fields)
+                    merged.update(overlays[jid])
+                    it.snap = MetaSnapshot(versions.get(meta_key(jid), 0), merged)
+                continue
+            # group lost a race (or a chain build failed): per-job commits
+            for it in members:
+                try:
+                    _, it.snap = await self.job_store.apply_chain(
+                        it.job_id, steps_for(it), snap=it.snap,
+                        extra_ops=list(extra_for(it)) if extra_for else None,
+                    )
+                except BaseException as e:
+                    await self._fail_item(it, e)
+
+    async def _batch_dispatch(self, items: list[_SubmitItem]) -> None:
+        """The grouped plain-ALLOW tail: one selection pass, one grouped
+        SCHEDULED commit, overlapped publishes + one grouped
+        DISPATCHED→RUNNING commit."""
+        # selection: one batched strategy pass (registry snapshot amortized)
+        st_spans = [
+            self.tracer.begin(
+                "strategy", trace_id=it.sched_sp.trace_id,
+                parent_span_id=it.sched_sp.span_id,
+                attrs={"job_id": it.req.job_id},
+            )
+            for it in items
+        ]
+        targets = self.strategy.pick_subjects([it.req for it in items])
+        for it, sp, target in zip(items, st_spans, targets):
+            it.target = target
+            sp.attrs["target"] = target
+            await self.tracer.finish(sp)
+        for it in items:
+            # fresh create → this is attempt 1 (mirrors the per-job tail)
+            it.pending["attempts"] = "1"
+
+        def sched_steps(it: _SubmitItem):
+            return [(JobState.SCHEDULED,
+                     {"dispatch_subject": it.target, **it.pending}, "scheduled")]
+
+        def sched_extra(it: _SubmitItem):
+            extra = self.job_store.put_safety_decision_ops(
+                self._decision_record(it.req, it.resp)
+            )
+            if it.req.tenant_id:
+                extra += self.job_store.tenant_active_add_ops(
+                    it.req.tenant_id, it.req.job_id
+                )
+            if it.req.budget and it.req.budget.deadline_unix_ms:
+                extra += self.job_store.register_deadline_ops(
+                    it.req.job_id, it.req.budget.deadline_unix_ms
+                )
+            return extra
+
+        await self._group_chain(items, sched_steps, sched_extra)
+        live = [it for it in items if not it.fut.done()]
+        if not live:
+            return
+
+        # dispatch: publishes overlap each other AND the grouped
+        # DISPATCHED→RUNNING bookkeeping commit (same contract as the
+        # per-job path: an undelivered publish leaves the job RUNNING for
+        # the reconciler's running-timeout to recover)
+        d_spans = []
+        pubs = []
+        for it in live:
+            dsp = self.tracer.begin(
+                "dispatch", trace_id=it.sched_sp.trace_id,
+                parent_span_id=it.sched_sp.span_id,
+                attrs={"job_id": it.req.job_id, "target": it.target},
+            )
+            d_spans.append(dsp)
+            self._stamp_partition(it.req)
+            out = BusPacket.wrap(
+                it.req, trace_id=it.trace_id, sender_id=self.instance_id,
+                span_id=dsp.span_id, parent_span_id=dsp.parent_span_id,
+            )
+            pubs.append(self.bus.publish(it.target, out))
+
+        def run_steps(it: _SubmitItem):
+            return [(JobState.DISPATCHED, None, "dispatched"),
+                    (JobState.RUNNING, None, "running")]
+
+        results = await asyncio.gather(
+            self._group_chain(live, run_steps), *pubs, return_exceptions=True
+        )
+        if isinstance(results[0], BaseException):
+            logx.error("batched DISPATCHED/RUNNING commit failed",
+                       err=str(results[0]))
+        for it, dsp, pub_res in zip(live, d_spans, results[1:]):
+            if isinstance(pub_res, BaseException):
+                dsp.attrs.setdefault("error", type(pub_res).__name__)
+                await self.tracer.finish(dsp, status="ERROR")
+                await self._fail_item(it, pub_res)
+                continue
+            await self.tracer.finish(dsp)
+            if it.fut.done():
+                continue  # run_steps commit failed this item
+            self._cache_snap(it.req.job_id, it.snap)
+            self.metrics.jobs_dispatched.inc(topic=it.req.topic)
+            self.metrics.shard_scheduled.inc(shard=self._shard_label)
+            sub_us = int(it.snap.get("submitted_at_us", "0") or 0)
+            if sub_us:
+                self.metrics.dispatch_latency.observe(
+                    max(0.0, (now_us() - sub_us) / 1e6)
+                )
+            await self._finish_item(it)
+
+    def _cache_snap(self, job_id: str, snap: MetaSnapshot) -> None:
+        """Remember the post-RUNNING snapshot so the result path commits
+        read-free; the cache is advisory (a conflict re-reads)."""
+        if len(self._snap_cache) > 65536:
+            self._snap_cache.clear()
+        self._snap_cache[job_id] = snap
+
+    async def _process_result_batch(self, items: list[_ResultItem]) -> None:
+        self.metrics.sched_tick_batch.observe(float(len(items)))
+        fast: list[_ResultItem] = []
+        fallback: list[_ResultItem] = []
+        seen: set[str] = set()
+        for it in items:
+            res = it.res
+            snap = self._snap_cache.pop(res.job_id, None)
+            try:
+                it.state = JobState(res.status)
+            except ValueError:
+                it.state = JobState.FAILED
+            if (
+                snap is None or snap.is_terminal
+                or it.state not in TERMINAL_STATES
+                or res.job_id in seen
+            ):
+                fallback.append(it)  # no cached snap / hint / dup-in-tick
+                continue
+            seen.add(res.job_id)
+            it.snap = snap
+            fast.append(it)
+        if fallback:
+            await eager_gather([self._fallback_result(it) for it in fallback])
+        if not fast:
+            return
+        for it in fast:
+            it.sched_sp = self.tracer.begin(
+                "result", trace_id=it.trace_id, parent_span_id=it.parent_span_id,
+                attrs={"job_id": it.res.job_id, "status": it.state.value},
+            )
+
+        def result_steps(it: _ResultItem):
+            return [(it.state, self._result_fields(it.res), "result")]
+
+        await self._group_chain(fast, result_steps)
+        for it in fast:
+            if it.fut.done():
+                # commit failed this item (e.g. a cancel won the race and the
+                # re-read raised IllegalTransition — the per-job path raises
+                # the same way); its future already carries the error
+                continue
+            self.metrics.jobs_completed.inc(status=it.state.value)
+            sub_us = int(it.snap.get("submitted_at_us", "0") or 0)
+            if sub_us:
+                self.metrics.e2e_latency.observe(max(0.0, (now_us() - sub_us) / 1e6))
+            if it.state in (JobState.FAILED, JobState.TIMEOUT):
+                req = await self.job_store.get_request(it.res.job_id)
+                if req is not None:
+                    await self._emit_dlq(
+                        req, it.res.error_message or it.state.value,
+                        it.res.error_code or it.state.value, status=it.state.value,
+                    )
+            await self._finish_item(it)
+
+    async def _fallback_result(self, it: _ResultItem) -> None:
+        try:
+            async with self._sem:
+                await self.handle_job_result(
+                    it.res, trace_id=it.trace_id, parent_span_id=it.parent_span_id
+                )
+        except BaseException as e:
+            if not it.fut.done():
+                it.fut.set_exception(e)
+        else:
+            if not it.fut.done():
+                it.fut.set_result(None)
+
+    @staticmethod
+    def _result_fields(res: JobResult) -> dict[str, str]:
+        fields = {
+            "result_ptr": res.result_ptr,
+            "worker_id": res.worker_id,
+            "execution_ms": str(res.execution_ms),
+        }
+        if res.error_message:
+            fields["error_message"] = res.error_message
+            fields["error_code"] = res.error_code
+        return fields
 
     async def handle_job_request(
         self, req: JobRequest, *, trace_id: str = "", parent_span_id: str = ""
@@ -217,17 +757,7 @@ class Engine:
         if not await self.job_store.acquire_job_lock(req.job_id, self.instance_id, ttl_s=30.0):
             raise RetryAfter(0.05, f"job {req.job_id} locked")
         try:
-            submit_fields = {
-                "topic": req.topic,
-                "tenant_id": req.tenant_id,
-                "principal_id": req.principal_id,
-                "context_ptr": req.context_ptr,
-                "workflow_id": req.workflow_id,
-                "run_id": req.run_id,
-                "trace_id": trace_id,
-                "priority": req.priority,
-                "submitted_at_us": str(time.time_ns() // 1000),
-            }
+            submit_fields = self._submit_fields(req, trace_id)
             create_extra = self.job_store.put_request_ops(req)
             create_extra += self.job_store.add_to_trace_ops(trace_id, req.job_id)
             # Optimistic fresh-job fast path: assume job:meta does not exist
@@ -313,6 +843,38 @@ class Engine:
         ) as polsp:
             resp = await self._check_safety(req)
             polsp.attrs["decision"] = resp.decision
+        # nested spans in the shared tail take explicit parents (the batched
+        # path has no per-job ambient context); here the ambient context IS
+        # the enclosing schedule span, so behavior is unchanged
+        ptrace, pspan = current_trace_context()
+        await self._post_decision(
+            req, resp, snap=snap, pending_fields=pending_fields,
+            trace_id=trace_id or ptrace, parent_span_id=pspan,
+        )
+
+    def _tenant_limit(self, req: JobRequest) -> int:
+        """Per-tenant concurrency limit: org-scoped effective config
+        (rate_limits.concurrent_jobs), else the global default."""
+        limit = self.tenant_concurrency_limit
+        eff_raw = (req.env or {}).get(ENV_EFFECTIVE_CONFIG)
+        if eff_raw and req.tenant_id:
+            eff = codec.loads_env_json(eff_raw)
+            if isinstance(eff, dict):
+                try:
+                    rate = eff.get("rate_limits") or {}
+                    limit = int(rate.get("concurrent_jobs", limit) or limit)
+                except (ValueError, TypeError, AttributeError):
+                    pass
+        return limit
+
+    async def _post_decision(
+        self, req: JobRequest, resp, *,
+        snap: MetaSnapshot, pending_fields: dict[str, str],
+        trace_id: str = "", parent_span_id: str = "",
+    ) -> None:
+        """Everything after the safety check: decision branches, tenant
+        gate, deadline, attempts guard, strategy pick, dispatch.  Shared by
+        the per-job path and the batched tick path's non-simple items."""
         decision = resp.decision
         decision_ops = self.job_store.put_safety_decision_ops(
             self._decision_record(req, resp)
@@ -352,16 +914,7 @@ class Engine:
         if decision == Decision.ALLOW_WITH_CONSTRAINTS.value and resp.constraints:
             self._apply_constraints(req, resp.constraints)
 
-        # tenant concurrency: per-tenant limit from the org-scoped effective
-        # config (rate_limits.concurrent_jobs), else the global default
-        limit = self.tenant_concurrency_limit
-        eff_raw = (req.env or {}).get(ENV_EFFECTIVE_CONFIG)
-        if eff_raw and req.tenant_id:
-            try:
-                rate = (json.loads(eff_raw).get("rate_limits") or {})
-                limit = int(rate.get("concurrent_jobs", limit) or limit)
-            except (ValueError, TypeError):
-                pass
+        limit = self._tenant_limit(req)
         if limit and req.tenant_id:
             active = await self.job_store.tenant_active_count(req.tenant_id)
             if active >= limit:
@@ -388,11 +941,14 @@ class Engine:
             return
 
         # pick subject and dispatch
-        async with self.tracer.span("strategy", attrs={"job_id": req.job_id}) as stsp:
+        async with self._spanctx(
+            "strategy", trace_id, parent_span_id, {"job_id": req.job_id}
+        ) as stsp:
             target = self.strategy.pick_subject(req)
             stsp.attrs["target"] = target
-        async with self.tracer.span(
-            "dispatch", attrs={"job_id": req.job_id, "target": target}
+        async with self._spanctx(
+            "dispatch", trace_id, parent_span_id,
+            {"job_id": req.job_id, "target": target},
         ) as dsp:
             # ONE pipelined commit: →SCHEDULED + decision record + tenant
             # membership + deadline + attempts/config fields (was 6-9
@@ -427,6 +983,7 @@ class Engine:
             for r in results:
                 if isinstance(r, BaseException):
                     raise r
+            self._cache_snap(req.job_id, results[1][1])
         self.metrics.jobs_dispatched.inc(topic=req.topic)
         self.metrics.shard_scheduled.inc(shard=self._shard_label)
         sub_us = int(snap.get("submitted_at_us", "0") or 0)
@@ -436,10 +993,14 @@ class Engine:
     def _stamp_partition(self, req: JobRequest) -> None:
         """Stamp this shard's partition on the outbound request so the
         worker can publish the result straight to ``sys.job.result.<p>``
-        (skipping the unstamped-result forwarding hop)."""
-        if self.shard_count > 1:
-            req.labels = dict(req.labels or {})
-            req.labels[LABEL_PARTITION] = self._shard_label
+        (skipping the unstamped-result forwarding hop).  Rebound to
+        :meth:`_stamp_noop` at construction when ``shard_count == 1``."""
+        req.labels = dict(req.labels or {})
+        req.labels[LABEL_PARTITION] = self._shard_label
+
+    @staticmethod
+    def _stamp_noop(req: JobRequest) -> None:
+        return None
 
     # ------------------------------------------------------------------
     async def redispatch_scheduled(self, job_id: str) -> bool:
@@ -531,10 +1092,9 @@ class Engine:
         )
         eff = (req.env or {}).get(ENV_EFFECTIVE_CONFIG)
         if eff:
-            try:
-                check.effective_config = json.loads(eff)
-            except ValueError:
-                pass
+            parsed = codec.loads_env_json(eff)
+            if isinstance(parsed, dict):
+                check.effective_config = parsed
         self.metrics.policy_evals.inc()
         return await self.safety.check(check)
 
@@ -552,7 +1112,11 @@ class Engine:
 
     def _apply_constraints(self, req: JobRequest, c: Constraints) -> None:
         req.env = dict(req.env or {})
-        req.env[ENV_POLICY_CONSTRAINTS] = json.dumps(c.to_dict(), sort_keys=True)
+        # the env contract stays JSON (non-Python workers parse it); the
+        # codec module owns contract-JSON under CL007
+        req.env[ENV_POLICY_CONSTRAINTS] = codec.dumps_env_json(
+            c.to_dict(), sort_keys=True
+        )
         if c.max_chips:
             req.env[ENV_MAX_CHIPS] = str(c.max_chips)
         for k, v in (c.env or {}).items():
@@ -586,6 +1150,12 @@ class Engine:
             return
         if not self.owns(res.job_id):
             await self._forward_to_owner("result", res.job_id, subj.result_subject, pkt)
+            return
+        if self.batch_ticks and self._drain_task is not None:
+            fut: asyncio.Future[None] = asyncio.get_running_loop().create_future()
+            self._result_q.append(_ResultItem(res, pkt.trace_id, pkt.span_id, fut))
+            self._submit_wake.set()
+            await fut
             return
         async with self._sem:
             await self.handle_job_result(

@@ -35,6 +35,10 @@ class PackedWorkers:
         self._topo_ids: dict[str, int] = {"": 0}
         self.worker_ids: list[str] = []
         self.n = 0
+        # bumped whenever an interning table grows: callers caching resolved
+        # routes (strategy _native_routes) key their entries on this so a
+        # newly appearing pool/capability invalidates stale resolutions
+        self.intern_gen = 0
 
     @property
     def available(self) -> bool:
@@ -47,6 +51,7 @@ class PackedWorkers:
                 return None  # capability space exhausted → python fallback
             bit = len(self._cap_ids)
             self._cap_ids[cap] = bit
+            self.intern_gen += 1
         return bit
 
     def _intern(self, table: dict[str, int], value: str) -> int:
@@ -54,6 +59,7 @@ class PackedWorkers:
         if vid is None:
             vid = len(table)
             table[value] = vid
+            self.intern_gen += 1
         return vid
 
     def _rebuild(self) -> None:
@@ -94,16 +100,9 @@ class PackedWorkers:
             self._healthy[i] = 1 if hb.devices_healthy else 0
         self._built_version = self.registry.version
 
-    def pick(
-        self,
-        *,
-        required_caps: list[str],
-        pool_names: list[str],
-        min_chips: int,
-        topology: str,
-    ) -> Optional[str]:
-        """Returns the chosen worker id, None for no-eligible-worker, or
-        raises LookupError when this request can't use the native path."""
+    def refresh(self) -> None:
+        """Rebuild the pack if the registry moved (or the rebuild interval
+        lapsed).  Raises LookupError when the native path is unusable."""
         if self._lib is None or self._degenerate:
             raise LookupError("native scan unavailable")
         now = time.monotonic()
@@ -115,27 +114,64 @@ class PackedWorkers:
             self._built_at = now
             if self._degenerate:
                 raise LookupError("capability space exhausted")
-        if self.n == 0:
-            return None
+
+    def prepare(
+        self,
+        *,
+        required_caps: list[str],
+        pool_names: list[str],
+        min_chips: int,
+        topology: str,
+    ) -> tuple:
+        """Resolve a routing shape to ready-to-call C-scan arguments.  The
+        result is cacheable until ``intern_gen`` changes (a pool/cap that
+        didn't exist at prepare time may exist later)."""
         req_caps = 0
         for cap in required_caps:
             b = self._cap_bit(cap)
             if b is None:
                 raise LookupError("capability space exhausted")
             req_caps |= 1 << b
+        pools = [self._pool_ids[p] for p in pool_names if p in self._pool_ids]
+        arr = (ctypes.c_int32 * max(1, len(pools)))(*pools or [0])
+        return (
+            ctypes.c_uint64(req_caps), arr, len(pools), bool(pool_names),
+            ctypes.c_int32(min_chips), topology,
+        )
+
+    def pick_prepared(self, prep: tuple) -> Optional[str]:
+        """Run the C scan with :meth:`prepare`'d arguments.  Caller must
+        :meth:`refresh` first (one refresh covers a whole batch of picks)."""
+        req_caps, arr, n_pools, had_pools, min_chips, topology = prep
+        if self.n == 0:
+            return None
+        if had_pools and not n_pools:
+            return None  # none of the eligible pools has live workers
         if topology and topology not in self._topo_ids:
             return None  # no worker reports this topology
         topo_id = self._topo_ids.get(topology, 0) if topology else 0
-        pools = [self._pool_ids[p] for p in pool_names if p in self._pool_ids]
-        if pool_names and not pools:
-            return None  # none of the eligible pools has live workers
-        arr = (ctypes.c_int32 * max(1, len(pools)))(*pools or [0])
         idx = self._lib.pick_worker(
             self.n, self._cap_bits, self._pool_id, self._topo_id, self._chips,
             self._active, self._maxp, self._cpu, self._duty, self._healthy,
-            ctypes.c_uint64(req_caps), arr, len(pools),
-            ctypes.c_int32(min_chips), ctypes.c_int32(topo_id),
+            req_caps, arr, n_pools,
+            min_chips, ctypes.c_int32(topo_id),
         )
         if idx < 0:
             return None
         return self.worker_ids[idx]
+
+    def pick(
+        self,
+        *,
+        required_caps: list[str],
+        pool_names: list[str],
+        min_chips: int,
+        topology: str,
+    ) -> Optional[str]:
+        """Returns the chosen worker id, None for no-eligible-worker, or
+        raises LookupError when this request can't use the native path."""
+        self.refresh()
+        return self.pick_prepared(self.prepare(
+            required_caps=required_caps, pool_names=pool_names,
+            min_chips=min_chips, topology=topology,
+        ))

@@ -12,6 +12,7 @@ import time
 from typing import Awaitable, Callable
 
 from ...protocol.types import Decision, PolicyCheckRequest, PolicyCheckResponse
+from ...utils.eager import eager
 
 CheckFn = Callable[[PolicyCheckRequest], Awaitable[PolicyCheckResponse]]
 
@@ -96,7 +97,15 @@ class SafetyClient:
         if not self.breaker.allow():
             return _deny("safety kernel circuit open (fail-closed)")
         try:
-            resp = await asyncio.wait_for(self._check(req), self.timeout_s)
+            # eager completion: an in-process kernel with a warm policy
+            # cache finishes without suspending — no Task, no timer.  The
+            # check timeout only matters for checks that actually park
+            # (remote RPC, cold reload), which take the wait_for path.
+            done, resp = eager(self._check(req))
+            if not done:
+                resp = await asyncio.wait_for(
+                    asyncio.ensure_future(resp), self.timeout_s
+                )
         except asyncio.TimeoutError:
             self.breaker.record_failure()
             return _deny("safety kernel check timed out (fail-closed)")

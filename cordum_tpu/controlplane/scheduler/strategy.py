@@ -49,6 +49,12 @@ class Strategy:
     def pick_subject(self, req: JobRequest) -> str:
         raise NotImplementedError
 
+    def pick_subjects(self, reqs: list[JobRequest]) -> list[str]:
+        """Batched selection (scheduler tick batching): one subject per
+        request.  The default just loops; strategies that scan state
+        per pick override this to amortize the scan across the batch."""
+        return [self.pick_subject(r) for r in reqs]
+
 
 class NaiveStrategy(Strategy):
     """Topic passthrough (reference strategy_naive.go)."""
@@ -126,6 +132,14 @@ class LeastLoadedStrategy(Strategy):
         self._pool_config = pool_config
         # batch affinity: batch_key -> (worker_id, stamped_monotonic)
         self._affinity: dict[str, tuple[str, float]] = {}
+        # routing caches (ISSUE 6): topic→pools and the native scan's
+        # resolved arguments are identical for every job of one shape, so
+        # re-deriving them per pick (regex parses, pool scans, ctypes array
+        # builds) was pure hot-path overhead.  Both caches invalidate on
+        # update_routing; native entries also carry the packed scan's
+        # interning generation (tables grow when new pools/caps appear).
+        self._topic_pools: dict[str, list[Pool]] = {}
+        self._native_routes: dict[tuple, tuple] = {}
         self._packed = None
         if native:
             try:
@@ -139,6 +153,17 @@ class LeastLoadedStrategy(Strategy):
 
     def update_routing(self, pool_config: PoolConfig) -> None:
         self._pool_config = pool_config
+        self._topic_pools = {}
+        self._native_routes = {}
+
+    def _pools_for_topic(self, topic: str) -> list[Pool]:
+        pools = self._topic_pools.get(topic)
+        if pools is None:
+            pools = self._pool_config.pools_for_topic(topic)
+            if len(self._topic_pools) > 4096:
+                self._topic_pools.clear()  # unbounded topic space guard
+            self._topic_pools[topic] = pools
+        return pools
 
     # -- batch affinity ---------------------------------------------------
     def _record_affinity(self, key: str, worker_id: str) -> None:
@@ -177,33 +202,85 @@ class LeastLoadedStrategy(Strategy):
         return worker_id
 
     def _native_pick(self, req: JobRequest, pools, job_requires) -> Optional[str]:
-        """Native packed scan for the common shape; LookupError → python."""
-        if self._packed is None:
+        """Native packed scan for the common shape; LookupError → python.
+
+        The per-shape resolution (pool-uniformity validation, requires
+        parsing, capability-bit and pool-id interning, the ctypes pools
+        array) is cached per ``(topic, requires)`` — only the C scan itself
+        runs per pick."""
+        packed = self._packed
+        if packed is None:
             raise LookupError("native disabled")
-        # pools must agree on constraints for the single-pass C scan
+        packed.refresh()  # rebuild pack if registry moved; may bump intern_gen
+        key = (req.topic, tuple(job_requires), tuple(p.name for p in pools))
+        ent = self._native_routes.get(key)
+        if ent is None or ent[0] != packed.intern_gen:
+            prep = self._resolve_native_route(pools, job_requires, packed)
+            if len(self._native_routes) > 4096:
+                self._native_routes.clear()
+            ent = (packed.intern_gen, prep)
+            self._native_routes[key] = ent
+        prep = ent[1]
+        if prep is None:
+            raise LookupError("shape not modeled by native scan")
+        return packed.pick_prepared(prep)
+
+    def _resolve_native_route(self, pools, job_requires, packed):
+        """→ prepared native-scan args, or None for shapes the C kernel
+        doesn't model (cached either way)."""
         first = pools[0]
+        # pools must agree on constraints for the single-pass C scan
         for p in pools[1:]:
             if (p.requires, p.min_chips, p.topology, p.device_kind) != (
                 first.requires, first.min_chips, first.topology, first.device_kind
             ):
-                raise LookupError("divergent pool constraints")
+                return None
         if first.device_kind:
-            raise LookupError("device_kind filter not in native scan")
+            return None  # device_kind filter not in native scan
         req_caps, min_chips, topology = _parse_tpu_requires(job_requires)
         pool_caps, pool_chips, pool_topology = _parse_tpu_requires(first.requires)
-        winner = self._packed.pick(
-            required_caps=req_caps + pool_caps,
-            pool_names=[p.name for p in pools],
-            min_chips=max(min_chips, pool_chips, first.min_chips),
-            topology=topology or pool_topology or first.topology,
-        )
-        return winner
+        try:
+            return packed.prepare(
+                required_caps=req_caps + pool_caps,
+                pool_names=[p.name for p in pools],
+                min_chips=max(min_chips, pool_chips, first.min_chips),
+                topology=topology or pool_topology or first.topology,
+            )
+        except LookupError:
+            return None
+
+    def pick_subjects(self, reqs: list[JobRequest]) -> list[str]:
+        """Batched selection: jobs sharing a routing shape (topic, requires,
+        routing labels) within one tick share ONE scan — the registry is
+        static between heartbeats, so sequential picks would return the
+        same worker anyway."""
+        memo: dict[tuple, str] = {}
+        out: list[str] = []
+        for req in reqs:
+            key = self._shape_key(req)
+            hit = memo.get(key)
+            if hit is None:
+                hit = self.pick_subject(req)
+                memo[key] = hit
+            out.append(hit)
+        return out
+
+    @staticmethod
+    def _shape_key(req: JobRequest) -> tuple:
+        labels = req.labels or {}
+        routing = tuple(sorted(
+            (k, v) for k, v in labels.items()
+            if k in ("preferred_worker_id", "preferred_pool", LABEL_BATCH_KEY)
+            or k.startswith("placement.")
+        ))
+        requires = tuple(req.metadata.requires) if req.metadata else ()
+        return (req.topic, requires, routing)
 
     def pick_subject(self, req: JobRequest) -> str:
         labels = req.labels or {}
         job_requires = list(req.metadata.requires) if req.metadata else []
 
-        pools = self._pool_config.pools_for_topic(req.topic)
+        pools = self._pools_for_topic(req.topic)
         if not pools:
             # topic not mapped to any pool: fan-in on the topic subject —
             # never direct-dispatch to workers whose pools don't serve it

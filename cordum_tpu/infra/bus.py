@@ -26,7 +26,7 @@ import itertools
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Awaitable, Callable, Optional
+from typing import Any, Awaitable, Callable, Optional
 
 from ..protocol import subjects as subj
 from ..protocol.types import BusPacket, LABEL_APPROVAL_GRANTED, LABEL_BUS_MSG_ID
@@ -50,11 +50,33 @@ class RetryAfter(Exception):
         self.delay_s = delay_s
 
 
+class _AttrGetter:
+    """dict.get-shaped view over an object's attributes (msg-id derivation
+    works on raw wire dicts AND typed payloads through one code path)."""
+
+    __slots__ = ("_obj",)
+
+    def __init__(self, obj: Any) -> None:
+        self._obj = obj
+
+    def __call__(self, name: str, default: Any = "") -> Any:
+        return getattr(self._obj, name, default)
+
+
 def compute_msg_id(subject: str, pkt: BusPacket) -> str:
     """Stable message id for dedupe: explicit label override, else derived
-    from the payload's job/worker identity (reference nats.go:404-435)."""
-    p = pkt.payload
-    labels = getattr(p, "labels", None) or {}
+    from the payload's job/worker identity (reference nats.go:404-435).
+
+    Works on the *raw* payload dict of a lazily decoded packet so the
+    dedupe/routing path never forces the dataclass conversion."""
+    p = pkt.raw_payload
+    if p is None:
+        p = pkt.payload
+    if type(p) is dict:
+        get = p.get
+    else:
+        get = _AttrGetter(p)
+    labels = get("labels", None) or {}
     if isinstance(labels, dict):
         override = labels.get(LABEL_BUS_MSG_ID)
         if override:
@@ -62,10 +84,10 @@ def compute_msg_id(subject: str, pkt: BusPacket) -> str:
     # spans: every span id is unique, so it IS the dedupe identity — two
     # spans of one trace finishing in the same microsecond must not collide
     # on the trace_id/created_at fall-through below
-    span_id = getattr(p, "span_id", "")
+    span_id = get("span_id", "")
     if span_id:
         return f"{subject}|{pkt.kind}|{span_id}"
-    job_id = getattr(p, "job_id", "")
+    job_id = get("job_id", "")
     if job_id:
         # Approval republishes reuse the job_id on the submit subject and must
         # NOT dedupe against the original submit — nor against each other (a
@@ -76,11 +98,11 @@ def compute_msg_id(subject: str, pkt: BusPacket) -> str:
             return f"{subject}|{pkt.kind}|{job_id}|approved|{pkt.created_at_us}"
         # Results carry a status: a terminal result must not dedupe against an
         # earlier non-terminal RUNNING hint for the same job.
-        status = getattr(p, "status", "")
+        status = get("status", "")
         if status:
             return f"{subject}|{pkt.kind}|{job_id}|{status}"
         return f"{subject}|{pkt.kind}|{job_id}"
-    worker_id = getattr(p, "worker_id", "")
+    worker_id = get("worker_id", "")
     if worker_id:
         # heartbeats must not dedupe against each other: include time bucket
         return f"{subject}|{pkt.kind}|{worker_id}|{pkt.created_at_us}"
@@ -113,6 +135,14 @@ class Bus:
     async def ping(self) -> bool:
         return True
 
+    def has_listener(self, subject: str) -> bool:
+        """Best-effort hint: may anything receive a publish to ``subject``?
+        Wire-backed buses can't know their remote subscribers, so the
+        default is the conservative True; the in-process bus answers
+        exactly, letting hot-path publishers (span emission) skip building
+        packets nobody will ever see."""
+        return True
+
 
 class Subscription:
     def __init__(self, unsub: Callable[[], None]) -> None:
@@ -133,6 +163,11 @@ class LoopbackBus(Bus):
 
     def __init__(self, *, sync: bool = False, durable: bool = True) -> None:
         self._subs: list[_Subscription] = []
+        # exact-pattern index: most subscriptions are concrete subjects, and
+        # matching every publish against every pattern (N_subs × N_publishes
+        # subject_match calls) was a measurable slice of the 1×1 hot path
+        self._exact: dict[str, list[_Subscription]] = {}
+        self._wild: list[_Subscription] = []
         self._sid = itertools.count(1)
         self._rr: dict[tuple[str, str], int] = {}
         self._sync = sync
@@ -147,16 +182,42 @@ class LoopbackBus(Bus):
     ) -> Subscription:
         sub = _Subscription(pattern, handler, queue, next(self._sid))
         self._subs.append(sub)
+        if "*" in pattern or ">" in pattern:
+            self._wild.append(sub)
+        else:
+            self._exact.setdefault(pattern, []).append(sub)
 
         def _unsub() -> None:
             sub.closed = True
             if sub in self._subs:
                 self._subs.remove(sub)
+            if sub in self._wild:
+                self._wild.remove(sub)
+            bucket = self._exact.get(sub.pattern)
+            if bucket and sub in bucket:
+                bucket.remove(sub)
+                if not bucket:
+                    del self._exact[sub.pattern]
 
         return Subscription(_unsub)
 
+    def has_listener(self, subject: str) -> bool:
+        bucket = self._exact.get(subject)
+        if bucket and any(not s.closed for s in bucket):
+            return True
+        return any(
+            not s.closed and subject_match(s.pattern, subject) for s in self._wild
+        )
+
     def _targets(self, subject: str) -> list[_Subscription]:
-        matched = [s for s in self._subs if not s.closed and subject_match(s.pattern, subject)]
+        matched = [s for s in self._exact.get(subject, ()) if not s.closed]
+        if self._wild:
+            matched += [
+                s for s in self._wild
+                if not s.closed and subject_match(s.pattern, subject)
+            ]
+        if not matched:
+            return matched
         # collapse queue groups to one member (round-robin)
         out: list[_Subscription] = []
         groups: dict[tuple[str, str], list[_Subscription]] = {}
@@ -189,12 +250,19 @@ class LoopbackBus(Bus):
     async def publish(self, subject: str, pkt: BusPacket) -> None:
         if self._closed:
             return
+        targets = self._targets(subject)
+        if not targets:
+            # nobody listening: skip dedupe bookkeeping AND the
+            # encode/decode round trip (delivery happens at publish time,
+            # so an unheard message is dropped either way)
+            self.published.append((subject, pkt))
+            return
         if self._durable and self._dedup_hit(subject, pkt):
             return
         self.published.append((subject, pkt))
         # round-trip through the wire format so both sides see the same shapes
         wire = pkt.to_wire()
-        for sub in self._targets(subject):
+        for sub in targets:
             decoded = BusPacket.from_wire(wire)
             if self._sync:
                 await self._deliver(sub, subject, decoded)
@@ -238,3 +306,5 @@ class LoopbackBus(Bus):
         for t in list(self._tasks):
             t.cancel()
         self._subs.clear()
+        self._exact.clear()
+        self._wild.clear()

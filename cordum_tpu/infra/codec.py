@@ -1,0 +1,75 @@
+"""Hot-path record codec: msgpack with legacy-JSON read compatibility.
+
+The wire protocol (BusPacket, statebus frames) has always been msgpack;
+until ISSUE 6 the jobstore's *stored* records — event-log entries, safety
+decisions, approvals — were still ``json.dumps``/``json.loads``, which was a
+measurable slice of the 1×1 scheduler hot path (cordumlint CL007 now keeps
+JSON out of those modules).  This module is the one place that:
+
+* encodes records as msgpack (``pack_record``),
+* decodes either encoding (``unpack_record``): new msgpack records AND
+  legacy JSON blobs written by pre-ISSUE-6 builds, so old AOF/KV data keeps
+  loading after an upgrade (JSON documents start with ``{``/``[``/``"`` or a
+  digit-ish prefix that msgpack would mis-read as a fixint, so the sniff is
+  on the JSON side), and
+* owns the *contract* JSON that deliberately stays JSON (values embedded in
+  worker env vars), with an interning cache so the scheduler doesn't
+  re-parse the same effective-config string once per job.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import msgpack
+
+# Legacy jobstore records were produced by json.dumps(dict) — they always
+# start with one of these bytes (allowing leading whitespace).
+_JSON_HEADS = frozenset(b"{[\"")
+
+
+def pack_record(obj: Any) -> bytes:
+    """Encode a stored record (event-log entry, decision, approval)."""
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack_record(b: bytes) -> Any:
+    """Decode a stored record written by this build (msgpack) or a
+    pre-ISSUE-6 build (JSON)."""
+    head = b.lstrip()[:1] if b else b""
+    if head and head[0] in _JSON_HEADS:
+        return json.loads(b)
+    return msgpack.unpackb(b, raw=False)
+
+
+# ---------------------------------------------------------------------------
+# contract JSON (worker env vars) — stays JSON, parsed/encoded here so the
+# hot-path modules stay msgpack-only under CL007
+# ---------------------------------------------------------------------------
+
+_PARSE_CACHE: dict[str, Any] = {}
+_PARSE_CACHE_CAP = 256
+
+
+def dumps_env_json(obj: Any, *, sort_keys: bool = False) -> str:
+    """JSON for values embedded in worker env vars (CORDUM_POLICY_CONSTRAINTS
+    etc.) — the env contract is JSON so non-Python workers can read it."""
+    return json.dumps(obj, sort_keys=sort_keys)
+
+
+def loads_env_json(s: str) -> Optional[Any]:
+    """Parse a JSON env-contract string, interning the result: the scheduler
+    sees the same effective-config string once per job, so the parse is
+    cached by the exact string.  Callers MUST treat the returned object as
+    read-only (it is shared across calls).  Returns None on invalid JSON."""
+    hit = _PARSE_CACHE.get(s)
+    if hit is not None:
+        return hit
+    try:
+        parsed = json.loads(s)
+    except (ValueError, TypeError):
+        return None
+    if len(_PARSE_CACHE) >= _PARSE_CACHE_CAP:
+        _PARSE_CACHE.clear()  # tiny cache; wholesale reset is fine
+    _PARSE_CACHE[s] = parsed
+    return parsed

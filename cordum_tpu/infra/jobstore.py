@@ -18,7 +18,6 @@ Recreates the semantics of the reference Redis job store
 """
 from __future__ import annotations
 
-import json
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -29,6 +28,7 @@ from ..protocol.types import (
     is_allowed_transition,
 )
 from ..utils.ids import now_us
+from .codec import pack_record, unpack_record
 from .kv import KV
 
 DEFAULT_META_TTL_S = 7 * 24 * 3600.0
@@ -198,7 +198,7 @@ class JobStore:
                 "prev": prev,
                 "event": event or f"state:{state.value}",
             }
-            ops.append(("rpush", events_key(job_id), json.dumps(ev).encode()))
+            ops.append(("rpush", events_key(job_id), pack_record(ev)))
             if state in TERMINAL_STATES:
                 ops.append(("zrem", DEADLINE_KEY, job_id))
                 tenant = (cur.get("tenant_id") or b"").decode()
@@ -213,6 +213,15 @@ class JobStore:
             ops.append(("ltrim", events_key(job_id), -EVENTS_CAP, -1))
             ops.append(("expire", key, self.meta_ttl_s))
         return ops, overlay, changed
+
+    def build_chain_ops(
+        self, job_id: str, snap: MetaSnapshot, steps: list[Transition]
+    ) -> tuple[list[tuple], dict[str, bytes], bool]:
+        """Public transition-op builder for callers that fold SEVERAL jobs'
+        chains into one grouped pipelined commit (scheduler tick batching):
+        returns ``(ops, overlay, changed)`` exactly like the internal
+        builder, leaving the commit (and its watches) to the caller."""
+        return self._chain_ops(job_id, snap, steps)
 
     async def apply_chain(
         self,
@@ -327,12 +336,14 @@ class JobStore:
     async def append_event(self, job_id: str, event: str, **kw: Any) -> None:
         ev = {"ts_us": now_us(), "event": event, **kw}
         await self.kv.pipe_execute({}, [
-            ("rpush", events_key(job_id), json.dumps(ev).encode()),
+            ("rpush", events_key(job_id), pack_record(ev)),
             ("ltrim", events_key(job_id), -EVENTS_CAP, -1),
         ])
 
     async def events(self, job_id: str) -> list[dict]:
-        return [json.loads(b) for b in await self.kv.lrange(events_key(job_id))]
+        # unpack_record reads both the msgpack entries this build
+        # writes and legacy JSON entries from pre-ISSUE-6 AOF/KV data
+        return [unpack_record(b) for b in await self.kv.lrange(events_key(job_id))]
 
     def add_to_trace_ops(self, trace_id: str, job_id: str) -> list[tuple]:
         return [("sadd", trace_key(trace_id), job_id)] if trace_id else []
@@ -404,28 +415,28 @@ class JobStore:
         rec.decided_at_us = rec.decided_at_us or now_us()
         return [(
             "set", f"job:safety:{rec.job_id}",
-            json.dumps(rec.__dict__).encode(), self.meta_ttl_s,
+            pack_record(rec.__dict__), self.meta_ttl_s,
         )]
 
     async def put_safety_decision(self, rec: SafetyDecisionRecord) -> None:
         rec.decided_at_us = rec.decided_at_us or now_us()
         await self.kv.set(
-            f"job:safety:{rec.job_id}", json.dumps(rec.__dict__).encode(), self.meta_ttl_s
+            f"job:safety:{rec.job_id}", pack_record(rec.__dict__), self.meta_ttl_s
         )
 
     async def get_safety_decision(self, job_id: str) -> Optional[SafetyDecisionRecord]:
         b = await self.kv.get(f"job:safety:{job_id}")
-        return SafetyDecisionRecord(**json.loads(b)) if b else None
+        return SafetyDecisionRecord(**unpack_record(b)) if b else None
 
     async def put_approval(self, rec: ApprovalRecord) -> None:
         rec.decided_at_us = rec.decided_at_us or now_us()
         await self.kv.set(
-            f"job:approval:{rec.job_id}", json.dumps(rec.__dict__).encode(), self.meta_ttl_s
+            f"job:approval:{rec.job_id}", pack_record(rec.__dict__), self.meta_ttl_s
         )
 
     async def get_approval(self, job_id: str) -> Optional[ApprovalRecord]:
         b = await self.kv.get(f"job:approval:{job_id}")
-        return ApprovalRecord(**json.loads(b)) if b else None
+        return ApprovalRecord(**unpack_record(b)) if b else None
 
     # ------------------------------------------------------------------
     async def cancel_job(self, job_id: str) -> bool:

@@ -266,6 +266,14 @@ class KV:
         """Start a buffered multi-op batch (see :class:`Pipeline`)."""
         return Pipeline(self)
 
+    def pipe_group(self, key: str) -> int:
+        """Grouping hint for CROSS-KEY pipelined commits: keys mapping to the
+        same group may be folded into one ``pipe_execute`` and commit
+        atomically (the scheduler's tick batching relies on this).  Single-
+        server stores put every key in group 0; the partitioned client
+        returns the key's partition index."""
+        return 0
+
     async def pipe_execute(
         self, watches: dict[str, int], ops: list[tuple]
     ) -> tuple[bool, dict[str, int]]:

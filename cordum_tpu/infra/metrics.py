@@ -204,6 +204,18 @@ class Metrics:
             "Wire frames folded into one coalesced statebus socket write",
             buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
         )
+        # scheduler tick batching (ISSUE 6): submits drained per scheduler
+        # loop tick into one selection pass + grouped pipelined commits
+        self.sched_tick_batch = Histogram(
+            "cordum_sched_tick_batch_size",
+            "Submits coalesced into one scheduler tick batch",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
+        )
+        self.sched_tick_fallbacks = Counter(
+            "cordum_sched_tick_fallback_total",
+            "Batched submits diverted to the per-job slow path (conflict, "
+            "duplicate-in-tick, or non-ALLOW decision)",
+        )
         self._families = [
             self.jobs_received,
             self.jobs_dispatched,
@@ -230,6 +242,8 @@ class Metrics:
             self.shard_forwarded,
             self.shard_queue_depth,
             self.statebus_coalesced_batch,
+            self.sched_tick_batch,
+            self.sched_tick_fallbacks,
         ]
 
     def render(self) -> str:

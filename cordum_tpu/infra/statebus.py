@@ -727,6 +727,15 @@ class PartitionedKV(KV):
     construction is the job's home partition for every control-plane pipe.
     """
 
+    def __new__(cls, parts: list[KV]) -> Any:
+        parts = list(parts)
+        if len(parts) == 1:
+            # identity dispatch chosen at construction: an unsharded store
+            # IS its single backend — no routing layer, no per-op branching
+            # on the 1×1 hot path (ISSUE 6)
+            return parts[0]
+        return super().__new__(cls)
+
     def __init__(self, parts: list[KV]) -> None:
         self.parts = list(parts)
         self.n = len(self.parts)
@@ -892,6 +901,12 @@ class PartitionedKV(KV):
     async def watch_read(self, key):
         return await self._one(key).watch_read(key)
 
+    def pipe_group(self, key: str) -> int:
+        """Keys on the same partition may share one grouped pipe commit."""
+        if self._member_is_global(key):
+            return 0
+        return partition_of(_route_key(key), self.n)
+
     def _pipe_part(self, watches: dict[str, int], ops: list[tuple]) -> KV:
         for key in watches:
             return self._one(key)
@@ -924,6 +939,12 @@ class PartitionedBus(Bus):
     brokers so no single event loop serializes the fleet's messaging.
     """
 
+    def __new__(cls, buses: list[Bus]) -> Any:
+        buses = list(buses)
+        if len(buses) == 1:
+            return buses[0]  # identity dispatch: see PartitionedKV.__new__
+        return super().__new__(cls)
+
     def __init__(self, buses: list[Bus]) -> None:
         self.buses = list(buses)
         self.n = len(self.buses)
@@ -933,6 +954,9 @@ class PartitionedBus(Bus):
 
     async def publish(self, subject: str, pkt: BusPacket) -> None:
         await self._bus_for(subject).publish(subject, pkt)
+
+    def has_listener(self, subject: str) -> bool:
+        return self._bus_for(subject).has_listener(subject)
 
     async def subscribe(self, pattern: str, handler, *, queue: Optional[str] = None) -> Subscription:
         if "*" in pattern or ">" in pattern:

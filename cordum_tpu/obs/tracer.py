@@ -27,7 +27,7 @@ from ..infra import logging as logx
 from ..infra.bus import Bus
 from ..protocol import subjects as subj
 from ..protocol.types import SPAN_ERROR, SPAN_OK, BusPacket, Span
-from ..utils.ids import new_id, now_us
+from ..utils.ids import fast_id, now_us
 
 # active (trace_id, span_id) for the current asyncio task tree
 _CTX: contextvars.ContextVar[tuple[str, str]] = contextvars.ContextVar(
@@ -80,7 +80,7 @@ class Tracer:
         if not parent and ctx_span and tid == ctx_trace:
             parent = ctx_span
         return Span(
-            span_id=new_id(),
+            span_id=fast_id(),
             parent_span_id=parent,
             trace_id=tid,
             name=name,
@@ -98,6 +98,12 @@ class Tracer:
     async def emit(self, span: Span) -> None:
         """Publish a finished span; never raises into the traced work."""
         if self.bus is None or not span.trace_id:
+            return
+        if not self.bus.has_listener(subj.TRACE_SPAN):
+            # no collector attached (1×1 bench / span-less deployments):
+            # skip the wrap+publish entirely — an unheard loopback publish
+            # is dropped at publish time anyway, and wire-backed buses
+            # always answer True
             return
         try:
             await self.bus.publish(
@@ -128,7 +134,14 @@ class Tracer:
         sp = self.begin(
             name, trace_id=trace_id, parent_span_id=parent_span_id, attrs=attrs
         )
-        token = _CTX.set((sp.trace_id, sp.span_id)) if sp.trace_id else None
+        # value-restore rather than ContextVar tokens: a token must be reset
+        # in the exact Context that created it, but eagerly-driven coroutines
+        # (utils/eager.py) may enter a span in the caller's context and exit
+        # in the continuation task's — restoring the saved value is identical
+        # in the single-context case and benign in the split case
+        prev = _CTX.get() if sp.trace_id else None
+        if sp.trace_id:
+            _CTX.set((sp.trace_id, sp.span_id))
         status = SPAN_OK
         try:
             yield sp
@@ -137,6 +150,6 @@ class Tracer:
             sp.attrs.setdefault("error", type(e).__name__)
             raise
         finally:
-            if token is not None:
-                _CTX.reset(token)
+            if prev is not None:
+                _CTX.set(prev)
             await self.finish(sp, status=status)

@@ -441,25 +441,89 @@ _PAYLOAD_TYPES: dict[str, type] = {
     "system_alert": SystemAlert,
     "span": Span,
 }
+# O(1) reverse lookup for wrap() (exact types only; payloads are always the
+# concrete dataclasses, and wrap() keeps an isinstance fallback for subclasses)
+_KIND_BY_TYPE: dict[type, str] = {t: k for k, t in _PAYLOAD_TYPES.items()}
 
 
-@dataclass
 class BusPacket(WireModel):
     """Envelope for every bus message (reference BusPacket oneof payload).
 
     ``span_id``/``parent_span_id`` carry flight-recorder span context across
     process boundaries: a receiver that starts a span for the work this
     packet triggers uses ``span_id`` as its parent (see docs/PROTOCOL.md
-    "Span context")."""
+    "Span context").
 
-    trace_id: str = ""
-    sender_id: str = ""
-    created_at_us: int = 0
-    protocol_version: int = PROTOCOL_VERSION
-    kind: str = ""
-    payload: Any = None
-    span_id: str = ""  # span under which this packet was published
-    parent_span_id: str = ""  # that span's parent (for single-hop rebuilds)
+    Codec fast paths (docs/PROTOCOL.md "Fast-path specialization"):
+
+    * **lazy decode** — ``from_wire``/``from_dict`` materialize only the
+      envelope; the typed payload dataclass is built on first access, so
+      routing-only consumers (dedupe, forward-to-owner, the statebus
+      server's subject router) never pay the dataclass conversion.
+    * **encode cache** — a packet decoded from the wire remembers its exact
+      bytes; re-publishing it (shard forwarding, redelivery) reuses them
+      instead of re-running ``to_dict``/``packb``.  Mutating ``payload``
+      drops the cache; mutating the payload object *in place* after the
+      first encode is a contract violation (stamp labels before wrapping).
+    """
+
+    __slots__ = (
+        "trace_id", "sender_id", "created_at_us", "protocol_version",
+        "kind", "span_id", "parent_span_id", "_payload", "_raw_payload",
+        "_wire",
+    )
+
+    def __init__(
+        self,
+        *,
+        trace_id: str = "",
+        sender_id: str = "",
+        created_at_us: int = 0,
+        protocol_version: int = PROTOCOL_VERSION,
+        kind: str = "",
+        payload: Any = None,
+        span_id: str = "",
+        parent_span_id: str = "",
+    ) -> None:
+        self.trace_id = trace_id
+        self.sender_id = sender_id
+        self.created_at_us = created_at_us
+        self.protocol_version = protocol_version
+        self.kind = kind
+        self.span_id = span_id  # span under which this packet was published
+        self.parent_span_id = parent_span_id  # that span's parent
+        self._payload = payload
+        self._raw_payload: Any = None
+        self._wire: Optional[bytes] = None
+
+    def __repr__(self) -> str:  # debugging/log parity with the old dataclass
+        return (
+            f"BusPacket(kind={self.kind!r}, trace_id={self.trace_id!r}, "
+            f"sender_id={self.sender_id!r}, payload={self._payload!r})"
+        )
+
+    @property
+    def payload(self) -> Any:
+        p = self._payload
+        if p is None and self._raw_payload is not None:
+            t = _PAYLOAD_TYPES.get(self.kind)
+            raw = self._raw_payload
+            p = t.from_dict(raw) if (t is not None and isinstance(raw, dict)) else raw
+            self._payload = p
+        return p
+
+    @payload.setter
+    def payload(self, value: Any) -> None:
+        self._payload = value
+        self._raw_payload = None
+        self._wire = None
+
+    @property
+    def raw_payload(self) -> Any:
+        """The payload as a plain wire dict when decoded lazily (None for
+        locally constructed packets) — lets routing code peek at envelope-
+        adjacent fields without forcing the dataclass conversion."""
+        return self._raw_payload
 
     @classmethod
     def wrap(
@@ -471,11 +535,12 @@ class BusPacket(WireModel):
         span_id: str = "",
         parent_span_id: str = "",
     ) -> "BusPacket":
-        kind = ""
-        for k, t in _PAYLOAD_TYPES.items():
-            if isinstance(payload, t):
-                kind = k
-                break
+        kind = _KIND_BY_TYPE.get(type(payload), "")
+        if not kind:
+            for k, t in _PAYLOAD_TYPES.items():
+                if isinstance(payload, t):
+                    kind = k
+                    break
         if not kind:
             raise TypeError(f"unsupported payload type {type(payload)!r}")
         return cls(
@@ -502,28 +567,41 @@ class BusPacket(WireModel):
             d["span_id"] = self.span_id
         if self.parent_span_id:
             d["parent_span_id"] = self.parent_span_id
-        if self.payload is not None:
-            d["payload"] = _to_plain(self.payload)
+        if self._payload is not None:
+            d["payload"] = _to_plain(self._payload)
+        elif self._raw_payload is not None:
+            d["payload"] = self._raw_payload
         return d
+
+    def to_wire(self) -> bytes:
+        w = self._wire
+        if w is None:
+            w = msgpack.packb(self.to_dict(), use_bin_type=True)
+            self._wire = w
+        return w
+
+    @classmethod
+    def from_wire(cls, b: bytes) -> Optional["BusPacket"]:
+        pkt = cls.from_dict(msgpack.unpackb(b, raw=False))
+        if pkt is not None:
+            pkt._wire = bytes(b)
+        return pkt
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> Optional["BusPacket"]:
         if d is None:
             return None
-        kind = d.get("kind", "")
-        payload = d.get("payload")
-        if payload is not None and kind in _PAYLOAD_TYPES:
-            payload = _PAYLOAD_TYPES[kind].from_dict(payload)
-        return cls(
+        pkt = cls(
             trace_id=d.get("trace_id", ""),
             sender_id=d.get("sender_id", ""),
             created_at_us=d.get("created_at_us", 0),
             protocol_version=d.get("protocol_version", PROTOCOL_VERSION),
-            kind=kind,
-            payload=payload,
+            kind=d.get("kind", ""),
             span_id=d.get("span_id", ""),
             parent_span_id=d.get("parent_span_id", ""),
         )
+        pkt._raw_payload = d.get("payload")
+        return pkt
 
     # typed accessors ------------------------------------------------------
     @property

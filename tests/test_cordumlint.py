@@ -299,6 +299,74 @@ def test_cl006_allows_compat_module(tmp_path):
     ) == []
 
 
+# ---------------------------------------------------------------- CL007
+
+CL007_BAD = """\
+import json
+
+def put(kv, rec):
+    return json.dumps(rec).encode()
+
+def get(b):
+    return json.loads(b)
+"""
+
+CL007_GOOD = """\
+from cordum_tpu.infra.codec import pack_record, unpack_record
+
+def put(kv, rec):
+    return pack_record(rec)
+
+def get(b):
+    return unpack_record(b)
+"""
+
+
+def test_cl007_fires_in_hot_path_module(tmp_path):
+    findings = run_lint(
+        tmp_path, "cordum_tpu/infra/jobstore.py", CL007_BAD, select={"CL007"}
+    )
+    assert rule_ids(findings) == ["CL007", "CL007"]
+    assert "msgpack codec" in findings[0].message
+
+
+def test_cl007_fires_in_every_declared_hot_module(tmp_path):
+    for mod in (
+        "cordum_tpu/infra/kv.py",
+        "cordum_tpu/infra/statebus.py",
+        "cordum_tpu/controlplane/scheduler/engine.py",
+    ):
+        findings = run_lint(tmp_path, mod, CL007_BAD, select={"CL007"})
+        assert rule_ids(findings) == ["CL007", "CL007"], mod
+
+
+def test_cl007_quiet_on_msgpack_codec(tmp_path):
+    assert run_lint(
+        tmp_path, "cordum_tpu/infra/jobstore.py", CL007_GOOD, select={"CL007"}
+    ) == []
+
+
+def test_cl007_quiet_outside_hot_paths(tmp_path):
+    # codec.py (the legacy-JSON fallback home) and arbitrary modules may
+    # use json freely — the rule is scoped to the declared hot modules
+    assert run_lint(
+        tmp_path, "cordum_tpu/infra/codec.py", CL007_BAD, select={"CL007"}
+    ) == []
+    assert run_lint(tmp_path, "cordum_tpu/cli.py", CL007_BAD, select={"CL007"}) == []
+
+
+def test_cl007_suppressible_inline(tmp_path):
+    src = (
+        "import json\n"
+        "def put(rec):\n"
+        "    return json.dumps(rec)  "
+        "# cordumlint: disable=CL007 -- legacy export path\n"
+    )
+    assert run_lint(
+        tmp_path, "cordum_tpu/infra/jobstore.py", src, select={"CL007"}
+    ) == []
+
+
 # ---------------------------------------------------------------- engine
 
 def test_inline_suppression(tmp_path):

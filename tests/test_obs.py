@@ -292,7 +292,14 @@ async def test_collector_consumes_bus_spans(kv, bus):
 # ---------------------------------------------------------------------------
 
 
+async def _sink(subject, pkt):
+    return None
+
+
 async def test_tracer_nested_spans_inherit_parent(bus):
+    # a listener must exist: with no TRACE_SPAN subscriber the tracer
+    # skips span publishing entirely (the 1×1 fast path)
+    await bus.subscribe(subj.TRACE_SPAN, _sink)
     t = Tracer("svc", bus)
     async with t.span("outer", trace_id="tr") as outer:
         assert current_trace_context() == ("tr", outer.span_id)
@@ -305,6 +312,7 @@ async def test_tracer_nested_spans_inherit_parent(bus):
 
 
 async def test_tracer_untraced_spans_not_published(bus):
+    await bus.subscribe(subj.TRACE_SPAN, _sink)
     t = Tracer("svc", bus)
     async with t.span("orphan") as sp:
         assert sp.trace_id == ""
@@ -312,6 +320,7 @@ async def test_tracer_untraced_spans_not_published(bus):
 
 
 async def test_tracer_error_marks_span(bus):
+    await bus.subscribe(subj.TRACE_SPAN, _sink)
     t = Tracer("svc", bus)
     try:
         async with t.span("boom", trace_id="tr"):

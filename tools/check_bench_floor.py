@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""CI perf-floor gate: fail the build when a bench metric regresses.
+
+Usage::
+
+    python tools/check_bench_floor.py BENCH_JSON [FLOOR_JSON]
+
+``BENCH_JSON`` is the file ``python bench.py --smoke`` wrote (the tool reads
+the LAST line that parses as a JSON object, matching the bench's one-line
+output contract).  ``FLOOR_JSON`` defaults to ``bench_floor.json`` next to
+this repo's root.
+
+The floor file has two sections keyed by bench-JSON metric name:
+
+* ``floors``   — the metric must be **>=** the stored value,
+* ``ceilings`` — the metric must be **<=** the stored value (round-trip
+  budgets: load-independent, so these are the tight deterministic guards).
+
+One derived metric is computed here rather than read from the doc:
+``sharded_vs_single_ratio`` = ``sharded_jobs_per_sec`` /
+``sharded_single_jobs_per_sec`` (same-run baseline, so a slow CI box can't
+fake a pass or a fail).
+
+Exit status: 0 when every metric holds its bound, 1 on any violation or
+missing metric — so ``test.yml`` can gate on it directly.  An r05-style
+hot-path regression (2428 → 1646 jobs/s shipped silently) is exactly what
+this catches.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+
+def load_bench_doc(path: str | Path) -> dict[str, Any]:
+    """Last JSON-object line of the bench output file."""
+    doc: Optional[dict[str, Any]] = None
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict):
+                doc = parsed
+    if doc is None:
+        raise SystemExit(f"no JSON object line found in {path}")
+    return doc
+
+
+def derive(doc: dict[str, Any]) -> dict[str, float]:
+    """Metrics the floor file may reference that the bench doc carries
+    only in parts."""
+    out: dict[str, float] = {}
+    sharded = doc.get("sharded_jobs_per_sec")
+    single = doc.get("sharded_single_jobs_per_sec")
+    if isinstance(sharded, (int, float)) and isinstance(single, (int, float)) and single > 0:
+        out["sharded_vs_single_ratio"] = float(sharded) / float(single)
+    return out
+
+
+def check(doc: dict[str, Any], floors_doc: dict[str, Any]) -> list[str]:
+    """Returns a list of violation messages (empty = pass)."""
+    derived = derive(doc)
+
+    def metric(name: str) -> Optional[float]:
+        v = derived.get(name, doc.get(name))
+        return float(v) if isinstance(v, (int, float)) else None
+
+    violations: list[str] = []
+    rows: list[tuple[str, str, Optional[float], float, bool]] = []
+    for name, floor in (floors_doc.get("floors") or {}).items():
+        v = metric(name)
+        ok = v is not None and v >= float(floor)
+        rows.append((name, ">=", v, float(floor), ok))
+        if not ok:
+            violations.append(
+                f"{name} = {v if v is not None else 'MISSING'} "
+                f"below floor {floor}"
+            )
+    for name, ceiling in (floors_doc.get("ceilings") or {}).items():
+        v = metric(name)
+        ok = v is not None and v <= float(ceiling)
+        rows.append((name, "<=", v, float(ceiling), ok))
+        if not ok:
+            violations.append(
+                f"{name} = {v if v is not None else 'MISSING'} "
+                f"above ceiling {ceiling}"
+            )
+    width = max((len(r[0]) for r in rows), default=10)
+    for name, op, v, bound, ok in rows:
+        shown = f"{v:.2f}" if v is not None else "MISSING"
+        print(f"  {'PASS' if ok else 'FAIL'}  {name:<{width}}  "
+              f"{shown:>12} {op} {bound}")
+    return violations
+
+
+def main(argv: list[str]) -> int:
+    if not argv or len(argv) > 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    bench_path = argv[0]
+    floor_path = argv[1] if len(argv) > 1 else str(
+        Path(__file__).resolve().parents[1] / "bench_floor.json"
+    )
+    doc = load_bench_doc(bench_path)
+    floors_doc = json.loads(Path(floor_path).read_text())
+    print(f"bench floor check: {bench_path} vs {floor_path}")
+    violations = check(doc, floors_doc)
+    if violations:
+        print("\nPERF FLOOR VIOLATIONS:", file=sys.stderr)
+        for v in violations:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    print("all perf floors hold")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

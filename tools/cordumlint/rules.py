@@ -387,6 +387,61 @@ class JaxCompatKwargs(Rule):
                     )
 
 
+# ---------------------------------------------------------------------------
+# CL007
+# ---------------------------------------------------------------------------
+
+_JSON_CODEC_FNS = {"dumps", "loads", "dump", "load"}
+
+
+class NoJsonOnHotPath(Rule):
+    """CL007: ``json.dumps``/``json.loads`` in scheduler hot-path modules.
+    The wire and the stored records are msgpack (ISSUE 6 moved the last
+    JSON codecs off the jobstore hot path — a measurable slice of the 1×1
+    regression); a JSON call creeping back in silently re-taxes every job.
+    Contract JSON (worker env vars) and legacy-read fallbacks live in
+    ``infra/codec.py``, which is the one place allowed to import json."""
+
+    id = "CL007"
+    name = "no-json-on-hot-path"
+    description = (
+        "json.dumps/json.loads forbidden in hot-path modules "
+        "(infra/jobstore.py, infra/kv.py, infra/statebus.py, "
+        "scheduler/engine.py); use infra/codec.py pack_record/unpack_record "
+        "or its env-contract helpers"
+    )
+
+    # the rule fires ONLY in these modules (inverse of allow_paths)
+    default_hot_paths = (
+        "cordum_tpu/infra/jobstore.py",
+        "cordum_tpu/infra/kv.py",
+        "cordum_tpu/infra/statebus.py",
+        "cordum_tpu/controlplane/scheduler/engine.py",
+    )
+
+    def check(self, ctx: LintContext) -> Iterator[Finding]:
+        hot = tuple(self.options.get("hot_paths", self.default_hot_paths))
+        if ctx.rel_path not in hot:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _JSON_CODEC_FNS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "json"
+            ):
+                yield self.finding(
+                    ctx, node,
+                    f"json.{fn.attr} on the scheduler hot path; use the "
+                    "msgpack codec (infra/codec.py pack_record/unpack_record) "
+                    "or, for env-contract JSON, its dumps_env_json/"
+                    "loads_env_json helpers",
+                )
+
+
 RULES: tuple[type[Rule], ...] = (
     NoWallClockDeadline,
     NoSilentSwallow,
@@ -394,4 +449,5 @@ RULES: tuple[type[Rule], ...] = (
     StateTransitionDiscipline,
     SubjectLiterals,
     JaxCompatKwargs,
+    NoJsonOnHotPath,
 )
