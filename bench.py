@@ -863,6 +863,13 @@ def _jax_child(device: str) -> None:
     except Exception as ex:  # noqa: BLE001
         out["batched_error"] = f"{type(ex).__name__}: {ex}"[:300]
 
+    # --- serving: continuous-batching decode vs sequential per-session ---
+    # (ISSUE 7 acceptance: decode_tokens_per_sec >= 2x sequential)
+    try:
+        out.update(asyncio.run(_bench_worker_serving(device)))
+    except Exception as ex:  # noqa: BLE001
+        out["serving_error"] = f"{type(ex).__name__}: {ex}"[:300]
+
     print(json.dumps(out), flush=True)
 
 
@@ -957,10 +964,169 @@ async def _bench_worker_embeds(device: str) -> dict:
     }
 
 
+async def _bench_worker_serving(device: str) -> dict:
+    """Multi-session ``llm.generate`` decode through a real Worker twice —
+    sequential (one session at a time: the no-continuous-batching baseline)
+    then open-loop (every session submitted at once, ragged continuous
+    batching) — reporting decode token rates, p50 inter-token latency and
+    mean decode-batch occupancy (ISSUE 7 acceptance: continuous ≥2× the
+    sequential rate of the same workload)."""
+    from cordum_tpu.infra.bus import LoopbackBus
+    from cordum_tpu.infra.kv import MemoryKV
+    from cordum_tpu.infra.memstore import MemoryStore
+    from cordum_tpu.models import llama
+    from cordum_tpu.protocol import subjects as subj
+    from cordum_tpu.protocol.types import BusPacket, JobRequest
+    from cordum_tpu.worker.handlers import (
+        TPUCompute, make_serving_engine, make_tpu_handlers,
+    )
+    from cordum_tpu.worker.runtime import Worker
+
+    if device == "cpu":
+        lcfg = llama.LlamaConfig.tiny()
+        n_sessions, max_new = 12, 40
+    else:
+        lcfg = llama.LlamaConfig(vocab_size=32000, d_model=1024, n_layers=8,
+                                 n_heads=8, n_kv_heads=4, d_ff=3584,
+                                 max_seq_len=512)
+        n_sessions, max_new = 32, 64
+    prompt_len, page_size = 8, 16
+    pages_per = -(-(prompt_len + max_new) // page_size)
+    cache_pages = n_sessions * pages_per + 8  # +null page +slack
+
+    async def run_pass(concurrent: bool) -> dict:
+        bus = LoopbackBus()
+        ms = MemoryStore(MemoryKV())
+        worker = Worker(bus=bus, store=ms, worker_id="bench-s",
+                        pool="bench", heartbeat_interval_s=999)
+        compute = TPUCompute(tp=1, llama_cfg=lcfg)
+        worker.register_default(make_tpu_handlers(compute))
+        worker.attach_serving(make_serving_engine(
+            compute, worker, cache_pages=cache_pages, page_size=page_size,
+            # the baseline pass admits ONE session at a time: the decode
+            # loop degenerates to per-session autoregression (what the
+            # fleet does without continuous batching)
+            max_sessions=n_sessions if concurrent else 1,
+            max_new_tokens=max_new,
+        ))
+        await worker.start()
+        be = worker.serving.backend
+        # warm every XLA program either pass can hit (the prompt's prefill
+        # bucket + the pow2 decode-batch ladder) so the timed window
+        # measures decode steps, not compilation
+        warm = [1, 2, 3]
+        be.prefill(list(range(2, prompt_len + 2)), warm)
+        top = n_sessions if concurrent else 1
+        bsz = 1
+        while True:  # 1, 2, 4, ... up to n_sessions' PADDED pow2 bucket
+            be.decode([(5, prompt_len, warm)] * bsz)
+            if bsz >= top:
+                break
+            bsz *= 2
+        waiters = {f"{'c' if concurrent else 'q'}{i}": asyncio.Event()
+                   for i in range(n_sessions)}
+
+        async def tap(subject, pkt):
+            res = pkt.job_result
+            if res is not None and res.job_id in waiters:
+                assert res.status == "SUCCEEDED", (res.job_id, res.status, res.error_message)
+                waiters[res.job_id].set()
+
+        sub = await bus.subscribe(subj.RESULT, tap)
+        reqs = []
+        for i, jid in enumerate(waiters):
+            ptr = await ms.put_context(jid, {
+                "op": "llm.generate",
+                "tokens": [(i * 7 + j) % lcfg.vocab_size for j in range(prompt_len)],
+                "max_new_tokens": max_new, "session_id": f"conv-{i}",
+                "stream": False,
+            })
+            reqs.append((jid, ptr))
+        # both passes are open-loop (all sessions offered upfront); the
+        # baseline's max_sessions=1 admission is what serializes it, so the
+        # comparison isolates continuous batching itself
+        t0 = time.perf_counter()
+        for jid, ptr in reqs:
+            await bus.publish(
+                subj.direct_subject("bench-s"),
+                BusPacket.wrap(JobRequest(job_id=jid, topic="job.tpu.generate",
+                                          context_ptr=ptr)),
+            )
+        await asyncio.wait_for(
+            asyncio.gather(*(w.wait() for w in waiters.values())),
+            timeout=JAX_TIMEOUT_S / 2,
+        )
+        dt = time.perf_counter() - t0
+        st = worker.serving.stats
+        steps = sorted(st.step_seconds)
+        sub.unsubscribe()
+        await worker.stop()
+        await bus.close()
+        return {
+            "tokens_per_sec": st.decoded_tokens / dt if dt > 0 else 0.0,
+            "p50_step_ms": (steps[len(steps) // 2] * 1000.0) if steps else 0.0,
+            "mean_occupancy": st.mean_occupancy,
+            "steps": st.steps,
+        }
+
+    seq = await run_pass(False)
+    cont = await run_pass(True)
+    return {
+        "decode_tokens_per_sec": round(cont["tokens_per_sec"], 1),
+        "sequential_decode_tokens_per_sec": round(seq["tokens_per_sec"], 1),
+        "serving_speedup": round(
+            cont["tokens_per_sec"] / seq["tokens_per_sec"], 2
+        ) if seq["tokens_per_sec"] else 0.0,
+        "p50_inter_token_ms": round(cont["p50_step_ms"], 2),
+        "serving_mean_occupancy": round(cont["mean_occupancy"], 2),
+        "serving_steps": cont["steps"],
+        "serving_sessions": n_sessions,
+    }
+
+
+def bench_session_affinity(n_sessions: int = 32, turns: int = 20,
+                           workers: int = 4) -> dict:
+    """Scheduler-side session-affinity hit rate: interleaved decode turns of
+    ``n_sessions`` conversations over a ``workers``-worker pool.  Steady
+    state (every turn after a session's first routing) must ride to the
+    worker holding the session's KV pages — the ISSUE 7 bar is ≥95%.
+    Pure control-plane: no jax, runs in the parent process."""
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.registry import WorkerRegistry
+    from cordum_tpu.protocol.types import Heartbeat, JobRequest, LABEL_SESSION_KEY
+
+    reg = WorkerRegistry()
+    pc = parse_pool_config({"topics": {"job.tpu.generate": "tpu"},
+                            "pools": {"tpu": {}}})
+    strat = LeastLoadedStrategy(reg, pc)
+    for w in range(workers):
+        reg.update(Heartbeat(worker_id=f"w{w}", pool="tpu",
+                             max_parallel_jobs=256))
+    routed: dict[str, set] = {}
+    for turn in range(turns):
+        for s in range(n_sessions):
+            subject = strat.pick_subject(JobRequest(
+                job_id=f"s{s}t{turn}", topic="job.tpu.generate",
+                labels={LABEL_SESSION_KEY: f"conv-{s}"},
+            ))
+            routed.setdefault(f"conv-{s}", set()).add(subject)
+    steady = strat.session_affinity_hits + strat.session_affinity_misses
+    return {
+        "serving_affinity_hit_rate": round(
+            strat.session_affinity_hits / steady, 4) if steady else 0.0,
+        "serving_affinity_sessions_smeared": sum(
+            1 for subs in routed.values() if len(subs) > 1),
+    }
+
+
 _CHILD_METRIC_KEYS = (
     "embeds_per_sec", "model_tokens_per_sec", "model_achieved_tflops",
     "model_params_m", "single_job_embeds_per_sec", "batched_embeds_per_sec",
     "batched_speedup", "batch_flushes", "max_batch_rows",
+    "decode_tokens_per_sec", "sequential_decode_tokens_per_sec",
+    "serving_speedup", "p50_inter_token_ms", "serving_mean_occupancy",
+    "serving_steps", "serving_sessions",
 )
 
 
@@ -1009,7 +1175,8 @@ def bench_jax(*, smoke: bool = False) -> dict:
                 continue
             results = dict(child)
             if all(k in child for k in
-                   ("embeds_per_sec", "model_tokens_per_sec", "batched_embeds_per_sec")):
+                   ("embeds_per_sec", "model_tokens_per_sec",
+                    "batched_embeds_per_sec", "decode_tokens_per_sec")):
                 return results
             # remember why the TPU pass failed, then try CPU for coverage;
             # only backfill embed_error if the embed bench itself is missing
@@ -1022,7 +1189,8 @@ def bench_jax(*, smoke: bool = False) -> dict:
                 if k not in results and k in child:
                     results[k] = child[k]
                     results["fallback_device"] = child.get("device", "cpu")
-            for k in ("embed_error", "model_error", "batched_error", "child_traceback"):
+            for k in ("embed_error", "model_error", "batched_error",
+                      "serving_error", "child_traceback"):
                 if k not in results and k in child:
                     results[k] = child[k]
             if "device" not in results and "device" in child:
@@ -1031,7 +1199,8 @@ def bench_jax(*, smoke: bool = False) -> dict:
     # context, not a failure (the noisy BENCH_r05 embed_error fix)
     for metric, err in (("embeds_per_sec", "embed_error"),
                         ("model_tokens_per_sec", "model_error"),
-                        ("batched_embeds_per_sec", "batched_error")):
+                        ("batched_embeds_per_sec", "batched_error"),
+                        ("decode_tokens_per_sec", "serving_error")):
         if metric in results and err in results and results.get("fallback_device"):
             results[f"tpu_{err}"] = results.pop(err)
     return results
@@ -1047,6 +1216,19 @@ def main() -> None:
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--shard-child":
         _shard_child(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+        return
+    if "--serving" in sys.argv:
+        # serving-only mode (ISSUE 7): the continuous-batching worker bench
+        # (in-process; set JAX_PLATFORMS=cpu off-TPU) + the scheduler
+        # session-affinity hit rate.  One JSON line, same keys as the full
+        # bench's serving section.
+        out = {"metric": "decode_tokens_per_sec"}
+        out.update(asyncio.run(_bench_worker_serving(
+            "cpu" if os.environ.get("JAX_PLATFORMS", "") == "cpu" else "tpu")))
+        out.update(bench_session_affinity())
+        out["value"] = out["decode_tokens_per_sec"]
+        out["unit"] = "tokens/s"
+        print(json.dumps(out))
         return
     smoke = "--smoke" in sys.argv
     profile = "--profile" in sys.argv or smoke  # smoke ships the breakdown in CI
@@ -1069,6 +1251,7 @@ def main() -> None:
     sharded_single = asyncio.run(bench_sharded(1, 1, sh_jobs))
     sel = bench_selection()
     prof = bench_profile() if profile else None
+    affinity = bench_session_affinity()
     jx = bench_jax(smoke=smoke)
     out = {
         "metric": "scheduled_jobs_per_sec",
@@ -1124,6 +1307,17 @@ def main() -> None:
         "batched_speedup": jx.get("batched_speedup", 0.0),
         "batch_flushes": jx.get("batch_flushes", 0),
         "batched_error": jx.get("batched_error", ""),
+        # serving (ISSUE 7): continuous-batching decode through the real
+        # worker path, vs sequential per-session decode of the same workload
+        "decode_tokens_per_sec": jx.get("decode_tokens_per_sec", 0.0),
+        "sequential_decode_tokens_per_sec": jx.get(
+            "sequential_decode_tokens_per_sec", 0.0),
+        "serving_speedup": jx.get("serving_speedup", 0.0),
+        "p50_inter_token_ms": jx.get("p50_inter_token_ms", 0.0),
+        "serving_mean_occupancy": jx.get("serving_mean_occupancy", 0.0),
+        "serving_sessions": jx.get("serving_sessions", 0),
+        "serving_error": jx.get("serving_error", ""),
+        **affinity,
     }
     if smoke:
         out["smoke"] = True
@@ -1131,10 +1325,11 @@ def main() -> None:
         # per-layer µs/op breakdown: routing / codec / selection / commit
         out["profile"] = prof
     for k in ("fallback_device", "tpu_skipped", "tpu_embed_error",
-              "tpu_model_error", "tpu_batched_error"):
+              "tpu_model_error", "tpu_batched_error", "tpu_serving_error"):
         if k in jx:
             out[k] = jx[k]
-    degraded = bool(out["embed_error"] or out["model_error"] or out["batched_error"])
+    degraded = bool(out["embed_error"] or out["model_error"]
+                    or out["batched_error"] or out["serving_error"])
     out["degraded"] = degraded
     if degraded:
         out["child_traceback"] = jx.get("child_traceback", "")
@@ -1145,6 +1340,7 @@ def main() -> None:
             f"    embed_error: {out['embed_error'] or '-'}\n"
             f"    model_error: {out['model_error'] or '-'}\n"
             f"    batched_error: {out['batched_error'] or '-'}\n"
+            f"    serving_error: {out['serving_error'] or '-'}\n"
         )
         if out["child_traceback"]:
             sys.stderr.write("--- child traceback (tail) ---\n")

@@ -26,6 +26,7 @@ from ..controlplane.scheduler.strategy import LeastLoadedStrategy
 from ..infra import logging as logx
 from ..infra.configsvc import ConfigService
 from ..infra.jobstore import JobStore
+from ..infra.metrics import Metrics
 from ..infra.registry import WorkerRegistry
 from ..infra.config import load_pool_config, load_timeouts
 from . import _boot
@@ -52,7 +53,9 @@ async def main() -> None:
 
     pool_cfg = load_pool_config(cfg.pool_config_path)
     timeouts = load_timeouts(cfg.timeout_config_path)
-    strategy = LeastLoadedStrategy(registry, pool_cfg)
+    # one registry shared by strategy (session-affinity counters) and engine
+    metrics = Metrics()
+    strategy = LeastLoadedStrategy(registry, pool_cfg, metrics=metrics)
     if shard_count <= 0:  # flag/env unset: pools.yaml scheduler.shards
         shard_count = pool_cfg.scheduler_shards
 
@@ -72,7 +75,7 @@ async def main() -> None:
 
     engine = Engine(
         bus=bus, job_store=job_store, safety=safety, strategy=strategy,
-        registry=registry, configsvc=configsvc,
+        registry=registry, configsvc=configsvc, metrics=metrics,
         instance_id=os.environ.get(
             "SCHEDULER_ID",
             f"scheduler-{shard_index}" if shard_count > 1 else "scheduler-0",

@@ -8,6 +8,13 @@ Micro-batching (cordum_tpu/batching) is on by default; limits come from the
 worker's pool stanza in pools.yaml (``max_batch_size`` /
 ``max_batch_wait_ms``), overridable via WORKER_MAX_BATCH_SIZE /
 WORKER_BATCH_WAIT_MS, and WORKER_BATCHING=0 disables it.
+
+Serving (cordum_tpu/serving, ``llm.generate``) is on by default too; the
+pool stanza's ``serving_cache_pages`` / ``serving_page_size`` /
+``serving_max_sessions`` / ``serving_max_new_tokens`` size the paged KV
+cache and admission control, overridable via WORKER_SERVING_CACHE_PAGES /
+WORKER_SERVING_PAGE_SIZE / WORKER_SERVING_MAX_SESSIONS /
+WORKER_SERVING_MAX_NEW_TOKENS, and WORKER_SERVING=0 disables the engine.
 """
 from __future__ import annotations
 
@@ -29,18 +36,19 @@ from ..worker.runtime import Worker
 from . import _boot
 
 
-def _pool_batch_limits(cfg, pool_name: str) -> tuple[int, float]:
-    """Batch limits for this worker's pool from pools.yaml (0/0.0 = defaults).
+def _pool_limits(cfg, pool_name: str):
+    """This worker's pool stanza from pools.yaml (None = defaults).
     A missing or invalid pool file must not stop a worker from booting."""
     try:
         from ..infra.config import load_pool_config
 
-        pool = load_pool_config(cfg.pool_config_path).pools.get(pool_name)
-    except Exception:  # noqa: BLE001 - batching config is best-effort
-        pool = None
-    if pool is None:
-        return 0, 0.0
-    return pool.max_batch_size, pool.max_batch_wait_ms
+        return load_pool_config(cfg.pool_config_path).pools.get(pool_name)
+    except Exception as e:  # noqa: BLE001 - batching/serving config is best-effort
+        from ..infra import logging as logx
+
+        logx.warn("pool config unreadable; using built-in worker defaults",
+                  path=cfg.pool_config_path, err=str(e))
+        return None
 
 
 async def main() -> None:
@@ -59,13 +67,24 @@ async def main() -> None:
         heartbeat_interval_s=_boot.env_float("WORKER_HEARTBEAT_INTERVAL", 10.0),
         region=env.get("WORKER_REGION", ""),
     )
-    pool_rows, pool_wait = _pool_batch_limits(cfg, pool_name)
+    pool = _pool_limits(cfg, pool_name)
     attach_default_tpu_worker(
         worker,
         tp=_boot.env_int("WORKER_TP", 1),
         batching=env.get("WORKER_BATCHING", "1") != "0",
-        max_batch_rows=_boot.env_int("WORKER_MAX_BATCH_SIZE", 0) or pool_rows or 32,
-        max_batch_wait_ms=_boot.env_float("WORKER_BATCH_WAIT_MS", 0.0) or pool_wait or 25.0,
+        max_batch_rows=_boot.env_int("WORKER_MAX_BATCH_SIZE", 0)
+        or (pool.max_batch_size if pool else 0) or 32,
+        max_batch_wait_ms=_boot.env_float("WORKER_BATCH_WAIT_MS", 0.0)
+        or (pool.max_batch_wait_ms if pool else 0.0) or 25.0,
+        serving=env.get("WORKER_SERVING", "1") != "0",
+        serving_cache_pages=_boot.env_int("WORKER_SERVING_CACHE_PAGES", 0)
+        or (pool.serving_cache_pages if pool else 0) or 128,
+        serving_page_size=_boot.env_int("WORKER_SERVING_PAGE_SIZE", 0)
+        or (pool.serving_page_size if pool else 0) or 16,
+        serving_max_sessions=_boot.env_int("WORKER_SERVING_MAX_SESSIONS", 0)
+        or (pool.serving_max_sessions if pool else 0) or 8,
+        serving_max_new_tokens=_boot.env_int("WORKER_SERVING_MAX_NEW_TOKENS", 0)
+        or (pool.serving_max_new_tokens if pool else 0) or 64,
     )
     await worker.start()
     try:

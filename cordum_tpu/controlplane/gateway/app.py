@@ -62,9 +62,11 @@ from ...protocol.types import (
     LABEL_BATCH_KEY,
     LABEL_BUS_MSG_ID,
     LABEL_SECRETS_PRESENT,
+    LABEL_SESSION_KEY,
     PolicyCheckRequest,
     TERMINAL_STATES,
     payload_batch_key,
+    payload_session_key,
 )
 from ...utils.ids import new_id, now_us
 from ...workflow.engine import Engine as WorkflowEngine, WorkflowError
@@ -416,6 +418,12 @@ class Gateway:
         bkey = payload_batch_key(payload)
         if bkey and LABEL_BATCH_KEY not in labels:
             labels[LABEL_BATCH_KEY] = bkey
+        # serving payloads carry their session id as a label so the
+        # scheduler can route every turn of a conversation to the worker
+        # holding its KV pages (session affinity, docs/SERVING.md)
+        skey = payload_session_key(payload)
+        if skey and LABEL_SESSION_KEY not in labels:
+            labels[LABEL_SESSION_KEY] = skey
         meta_doc = body.get("metadata") or {}
         metadata = JobMetadata(
             capability=str(meta_doc.get("capability", "")),

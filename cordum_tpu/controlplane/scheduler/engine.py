@@ -53,6 +53,7 @@ from ...protocol.types import (
     LABEL_APPROVAL_GRANTED,
     LABEL_PARTITION,
     PolicyCheckRequest,
+    STATUS_HINT_STREAM,
     TERMINAL_STATES,
 )
 from .safety_client import SafetyClient
@@ -265,6 +266,12 @@ class Engine:
     async def _on_progress(self, subject: str, pkt: BusPacket) -> None:
         pr = pkt.job_progress
         if pr is None or not pr.job_id:
+            return
+        if pr.status_hint == STATUS_HINT_STREAM:
+            # llm.generate token-stream packets are transport, not state:
+            # the gateway WS tap relays them live and the terminal result
+            # carries the full token list — persisting one event per decode
+            # step would swamp the job store
             return
         if not self.owns(pr.job_id):
             return  # progress fans out to every shard; only the owner records

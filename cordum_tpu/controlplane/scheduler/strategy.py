@@ -19,6 +19,11 @@ TPU-slice awareness:
     the worker that last won for that key (TTL'd), so the worker-side
     micro-batch queues actually fill instead of each job landing on a
     different slice (docs/BATCHING.md)
+  * session affinity (the serving generalization of batch affinity): jobs
+    carrying ``cordum.session_key`` stick to the worker holding that
+    conversation's KV pages, with a much longer TTL sized to conversation
+    gaps rather than batch-fill windows (docs/SERVING.md); hit/miss/new
+    outcomes feed ``cordum_session_affinity_total``
   * chosen worker → direct subject ``worker.<id>.jobs``; no worker →
     topic fan-in subject (queue-group consumption)
 
@@ -34,7 +39,12 @@ from typing import Optional
 from ...infra.config import Pool, PoolConfig
 from ...infra.registry import WorkerRegistry
 from ...protocol.subjects import direct_subject
-from ...protocol.types import Heartbeat, JobRequest, LABEL_BATCH_KEY
+from ...protocol.types import (
+    Heartbeat,
+    JobRequest,
+    LABEL_BATCH_KEY,
+    LABEL_SESSION_KEY,
+)
 
 _CHIPS_RE = re.compile(r"^chips:(\d+)$")
 _TOPOLOGY_RE = re.compile(r"^topology:([0-9x]+)$")
@@ -42,7 +52,14 @@ _TOPOLOGY_RE = re.compile(r"^topology:([0-9x]+)$")
 OVERLOAD_FRACTION = 0.9
 OVERLOAD_UTIL = 90.0
 BATCH_AFFINITY_TTL_S = 5.0
+# Sessions outlive batch-fill windows: the TTL covers think-time between a
+# conversation's turns, after which its KV pages are presumed reclaimed and
+# re-routing is free.
+SESSION_AFFINITY_TTL_S = 120.0
 _AFFINITY_CAP = 1024
+# internal key namespace so an arbitrary session id can never collide with
+# a batch key (batch keys stay raw for back-compat)
+_SESSION_PREFIX = "session\x00"
 
 
 class Strategy:
@@ -127,11 +144,18 @@ def _placement_labels(labels: dict[str, str]) -> dict[str, str]:
 
 
 class LeastLoadedStrategy(Strategy):
-    def __init__(self, registry: WorkerRegistry, pool_config: PoolConfig, *, native: bool = True):
+    def __init__(self, registry: WorkerRegistry, pool_config: PoolConfig, *,
+                 native: bool = True, metrics=None):
         self.registry = registry
         self._pool_config = pool_config
-        # batch affinity: batch_key -> (worker_id, stamped_monotonic)
+        self.metrics = metrics
+        # affinity: batch_key / namespaced session_key -> (worker_id, stamp)
         self._affinity: dict[str, tuple[str, float]] = {}
+        # session-affinity outcome counters (the bench's affinity-hit-rate
+        # source; mirrored to cordum_session_affinity_total when metrics set)
+        self.session_affinity_hits = 0
+        self.session_affinity_misses = 0
+        self.session_affinity_new = 0
         # routing caches (ISSUE 6): topic→pools and the native scan's
         # resolved arguments are identical for every job of one shape, so
         # re-deriving them per pick (regex parses, pool scans, ctypes array
@@ -175,17 +199,18 @@ class LeastLoadedStrategy(Strategy):
 
     def _affinity_worker(
         self, key: str, pools: list[Pool], job_requires: list[str],
-        placement: dict[str, str],
+        placement: dict[str, str], ttl_s: float = BATCH_AFFINITY_TTL_S,
     ) -> str:
-        """The sticky worker for a batch key, if it is still a legal target.
-        An overloaded / vanished / no-longer-eligible sticky worker returns
-        "" so the scan below elects (and records) a new one — the whole
-        key's queue migrates together instead of smearing across workers."""
+        """The sticky worker for an affinity key, if it is still a legal
+        target.  An overloaded / vanished / no-longer-eligible sticky worker
+        returns "" so the scan below elects (and records) a new one — the
+        whole key's queue (or session) migrates together instead of smearing
+        across workers."""
         ent = self._affinity.get(key)
         if ent is None:
             return ""
         worker_id, stamped = ent
-        if time.monotonic() - stamped >= BATCH_AFFINITY_TTL_S:
+        if time.monotonic() - stamped >= ttl_s:
             self._affinity.pop(key, None)
             return ""
         hb = self.registry.get(worker_id)
@@ -270,11 +295,22 @@ class LeastLoadedStrategy(Strategy):
         labels = req.labels or {}
         routing = tuple(sorted(
             (k, v) for k, v in labels.items()
-            if k in ("preferred_worker_id", "preferred_pool", LABEL_BATCH_KEY)
+            if k in ("preferred_worker_id", "preferred_pool",
+                     LABEL_BATCH_KEY, LABEL_SESSION_KEY)
             or k.startswith("placement.")
         ))
         requires = tuple(req.metadata.requires) if req.metadata else ()
         return (req.topic, requires, routing)
+
+    def _count_session_affinity(self, outcome: str) -> None:
+        if outcome == "hit":
+            self.session_affinity_hits += 1
+        elif outcome == "miss":
+            self.session_affinity_misses += 1
+        else:
+            self.session_affinity_new += 1
+        if self.metrics is not None:
+            self.metrics.session_affinity.inc(outcome=outcome)
 
     def pick_subject(self, req: JobRequest) -> str:
         labels = req.labels or {}
@@ -304,6 +340,26 @@ class LeastLoadedStrategy(Strategy):
             if hinted:
                 pools = hinted
 
+        # session affinity: a conversation's decode turns ride to the worker
+        # holding its KV pages (the serving generalization of batch affinity;
+        # explicit worker hints still win above)
+        session_key = labels.get(LABEL_SESSION_KEY, "")
+        session_akey = ""
+        if session_key:
+            session_akey = _SESSION_PREFIX + session_key
+            had_entry = session_akey in self._affinity
+            sticky = self._affinity_worker(
+                session_akey, pools, job_requires, placement,
+                ttl_s=SESSION_AFFINITY_TTL_S,
+            )
+            if sticky:
+                self._count_session_affinity("hit")
+                return direct_subject(sticky)
+            # a dead entry (expired / evicted) means the session's pages are
+            # on a worker we can no longer use: a true migration, vs "new"
+            # for the first routing of a session
+            self._count_session_affinity("miss" if had_entry else "new")
+
         # batch affinity: same-key jobs ride to the sticky worker so its
         # micro-batch queues fill (explicit worker hints still win above)
         batch_key = labels.get(LABEL_BATCH_KEY, "")
@@ -316,8 +372,11 @@ class LeastLoadedStrategy(Strategy):
         if not placement and not preferred_worker:
             try:
                 winner = self._native_pick(req, pools, job_requires)
-                if winner and batch_key:
-                    self._record_affinity(batch_key, winner)
+                if winner:
+                    if batch_key:
+                        self._record_affinity(batch_key, winner)
+                    if session_akey:
+                        self._record_affinity(session_akey, winner)
                 return direct_subject(winner) if winner else req.topic
             except LookupError:
                 pass  # shapes the C kernel doesn't model → python scan
@@ -346,5 +405,7 @@ class LeastLoadedStrategy(Strategy):
         if best_worker:
             if batch_key:
                 self._record_affinity(batch_key, best_worker)
+            if session_akey:
+                self._record_affinity(session_akey, best_worker)
             return direct_subject(best_worker)
         return req.topic

@@ -76,6 +76,12 @@ class Pool:
     # 0 = the worker's built-in defaults
     max_batch_size: int = 0  # rows per flushed XLA call
     max_batch_wait_ms: float = 0.0  # adaptive-window ceiling
+    # serving limits for this pool's workers (cordum_tpu/serving,
+    # docs/SERVING.md); 0 = the worker's built-in defaults
+    serving_cache_pages: int = 0  # KV page-arena size (page 0 is reserved)
+    serving_page_size: int = 0  # token slots per page
+    serving_max_sessions: int = 0  # concurrent decode sessions per worker
+    serving_max_new_tokens: int = 0  # per-request generation cap
 
 
 @dataclass
@@ -115,6 +121,10 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
             device_kind=str(p.get("device_kind") or ""),
             max_batch_size=int(p.get("max_batch_size") or 0),
             max_batch_wait_ms=float(p.get("max_batch_wait_ms") or 0.0),
+            serving_cache_pages=int(p.get("serving_cache_pages") or 0),
+            serving_page_size=int(p.get("serving_page_size") or 0),
+            serving_max_sessions=int(p.get("serving_max_sessions") or 0),
+            serving_max_new_tokens=int(p.get("serving_max_new_tokens") or 0),
         )
     for topic, pools in (doc.get("topics") or {}).items():
         if isinstance(pools, str):

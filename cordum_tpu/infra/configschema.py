@@ -58,6 +58,11 @@ POOLS_SCHEMA: dict[str, Any] = {
                         # micro-batching limits (cordum_tpu/batching)
                         "max_batch_size": _NONNEG_INT,
                         "max_batch_wait_ms": _NONNEG,
+                        # serving limits (cordum_tpu/serving, docs/SERVING.md)
+                        "serving_cache_pages": _NONNEG_INT,
+                        "serving_page_size": _NONNEG_INT,
+                        "serving_max_sessions": _NONNEG_INT,
+                        "serving_max_new_tokens": _NONNEG_INT,
                     },
                     "additionalProperties": False,
                 }],

@@ -216,6 +216,37 @@ class Metrics:
             "Batched submits diverted to the per-job slow path (conflict, "
             "duplicate-in-tick, or non-ALLOW decision)",
         )
+        # serving subsystem (cordum_tpu/serving): continuous-batching decode
+        self.serving_batch_occupancy = Histogram(
+            "cordum_serving_batch_occupancy",
+            "Sessions riding one continuous-batching decode step",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+        )
+        self.serving_inter_token = Histogram(
+            "cordum_serving_inter_token_seconds",
+            "Wall time per decode step (inter-token latency)",
+        )
+        self.serving_admitted = Counter(
+            "cordum_serving_sessions_admitted_total",
+            "Sessions admitted into the decode loop",
+        )
+        self.serving_retired = Counter(
+            "cordum_serving_sessions_retired_total",
+            "Sessions retired from the decode loop, by reason",
+        )
+        self.serving_sessions = Gauge(
+            "cordum_serving_active_sessions",
+            "Sessions currently in the decode set",
+        )
+        self.serving_kv_pages_in_use = Gauge(
+            "cordum_serving_kv_pages_in_use",
+            "KV cache pages currently allocated to sessions",
+        )
+        self.session_affinity = Counter(
+            "cordum_session_affinity_total",
+            "Session-keyed routing outcomes (hit = routed to the worker "
+            "holding the session's KV pages)",
+        )
         self._families = [
             self.jobs_received,
             self.jobs_dispatched,
@@ -244,6 +275,13 @@ class Metrics:
             self.statebus_coalesced_batch,
             self.sched_tick_batch,
             self.sched_tick_fallbacks,
+            self.serving_batch_occupancy,
+            self.serving_inter_token,
+            self.serving_admitted,
+            self.serving_retired,
+            self.serving_sessions,
+            self.serving_kv_pages_in_use,
+            self.session_affinity,
         ]
 
     def render(self) -> str:

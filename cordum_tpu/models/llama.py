@@ -174,7 +174,7 @@ def _attention(q, k, v, cfg: LlamaConfig, *, causal: bool = True, q_offset=None)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block(x, layer, cfg: LlamaConfig, positions, constrain, mesh=None):
+def _block(x, layer, cfg: LlamaConfig, positions, constrain, mesh=None, collect_kv=False):
     b, t, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -184,6 +184,7 @@ def _block(x, layer, cfg: LlamaConfig, positions, constrain, mesh=None):
     v = (attn_in @ layer["wv"]).reshape(b, t, kvh, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
+    kv = (k, v) if collect_kv else None  # post-rope K is what the pages cache
     if cfg.use_ring_attention and mesh is not None and mesh.shape.get(AXIS_SP, 1) > 1:
         # ring flavor: K/V never materialize the full sequence anywhere —
         # chunks rotate the sp ring with an online softmax (long contexts)
@@ -204,7 +205,10 @@ def _block(x, layer, cfg: LlamaConfig, positions, constrain, mesh=None):
     gate = jax.nn.silu(mlp_in @ layer["w_gate"])
     up = mlp_in @ layer["w_up"]
     x = x + ((gate * up) @ layer["w_down"])
-    return constrain(x, P(AXIS_DP, AXIS_SP, None))
+    x = constrain(x, P(AXIS_DP, AXIS_SP, None))
+    if collect_kv:
+        return x, kv
+    return x
 
 
 def forward(
@@ -232,6 +236,122 @@ def forward(
         x = _block(x, layer, cfg, positions, constrain, mesh=mesh)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return x @ params["lm_head"]
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache: prefill / decode-step entry points (serving subsystem)
+# ---------------------------------------------------------------------------
+#
+# The serving path (cordum_tpu/serving) holds the conversation KV cache as a
+# block-granular page arena shaped [L, num_pages, page_size, kvh, hd]; a
+# sequence's logical position ``p`` lives at page ``page_table[p // ps]``,
+# slot ``p % ps`` (the Ragged Paged Attention layout, PAPERS.md — here a
+# gather-based jnp formulation that runs anywhere; a Pallas kernel that walks
+# the page table in VMEM is the TPU upgrade path).  Page 0 is the NULL page:
+# padding rows and padded page-table tails point at it, so their writes land
+# harmlessly in slots no live sequence ever attends to (the causal mask cuts
+# every k_pos > position).
+
+
+def init_kv_pages(
+    cfg: LlamaConfig, num_pages: int, page_size: int, dtype: Any = None
+) -> tuple[jax.Array, jax.Array]:
+    """Preallocated page arenas for K and V: [L, num_pages, page_size, kvh, hd]."""
+    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    dt = dtype or cfg.dtype
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def prefill_forward(
+    params: Params, tokens: jax.Array, cfg: LlamaConfig
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Full-sequence forward that also returns the per-layer post-rope K/V.
+
+    tokens: [B, T] int32 → (logits [B, T, V], k [L, B, T, kvh, hd], v [...]).
+    The caller scatters the K/V of the real (unpadded) positions into the
+    session's KV pages (see :func:`scatter_prefill_kv`)."""
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+
+    def constrain(x, spec):  # serving prefill is single-host per worker
+        return x
+
+    x = params["embed"][tokens]
+    ks, vs = [], []
+    for layer in params["layers"]:
+        x, (k, v) = _block(x, layer, cfg, positions, constrain, collect_kv=True)
+        ks.append(k)
+        vs.append(v)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return x @ params["lm_head"], jnp.stack(ks), jnp.stack(vs)
+
+
+def scatter_prefill_kv(
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    ks: jax.Array,
+    vs: jax.Array,
+    page_ids: jax.Array,
+    slots: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Write one sequence's prefill K/V into its pages.
+
+    ks/vs: [L, T, kvh, hd] (batch dim already squeezed); page_ids/slots: [T]
+    int32 mapping position t → (page, slot).  Padded tail positions should
+    point at the null page (page 0)."""
+    k_pages = k_pages.at[:, page_ids, slots].set(ks)
+    v_pages = v_pages.at[:, page_ids, slots].set(vs)
+    return k_pages, v_pages
+
+
+def decode_step(
+    params: Params,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+    page_tables: jax.Array,
+    cfg: LlamaConfig,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One continuous-batching decode step over the paged KV cache.
+
+    tokens: [B] int32 (each sequence's last emitted token); positions: [B]
+    int32 (the slot this token occupies — its current length); page_tables:
+    [B, P] int32.  Returns (next_tokens [B] int32, k_pages, v_pages).
+
+    The ragged batch is uniform in shape only: each row attends to exactly
+    ``positions[b] + 1`` cached entries via the causal mask, so rows of
+    different lengths (and padding rows parked on the null page) share one
+    XLA program without seeing each other's state."""
+    b = tokens.shape[0]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ps = k_pages.shape[2]
+    pos2 = positions[:, None]  # [B, 1]
+    page_idx = jnp.take_along_axis(page_tables, pos2 // ps, axis=1)[:, 0]  # [B]
+    slot = positions % ps
+    x = params["embed"][tokens][:, None, :]  # [B, 1, d]
+    for li, layer in enumerate(params["layers"]):
+        attn_in = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (attn_in @ layer["wq"]).reshape(b, 1, h, hd)
+        k = (attn_in @ layer["wk"]).reshape(b, 1, kvh, hd)
+        v = (attn_in @ layer["wv"]).reshape(b, 1, kvh, hd)
+        q = rope(q, pos2, cfg.rope_theta)
+        k = rope(k, pos2, cfg.rope_theta)
+        # append this token's K/V to its page BEFORE the gather so the token
+        # attends to itself
+        k_pages = k_pages.at[li, page_idx, slot].set(k[:, 0])
+        v_pages = v_pages.at[li, page_idx, slot].set(v[:, 0])
+        kc = k_pages[li][page_tables].reshape(b, -1, kvh, hd)  # [B, P*ps, kvh, hd]
+        vc = v_pages[li][page_tables].reshape(b, -1, kvh, hd)
+        attn = _attention(q, kc, vc, cfg, q_offset=pos2)
+        x = x + (attn.reshape(b, 1, h * hd) @ layer["wo"])
+        mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(mlp_in @ layer["w_gate"])
+        up = mlp_in @ layer["w_up"]
+        x = x + ((gate * up) @ layer["w_down"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"])[:, 0]  # [B, V]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
 
 
 def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig, *, mesh=None) -> jax.Array:

@@ -322,6 +322,11 @@ class JobProgress(WireModel):
     artifact_ptrs: list[str] = field(default_factory=list)
     status_hint: str = ""
     worker_id: str = ""
+    # llm.generate token stream: the tokens emitted since the last progress
+    # packet, with status_hint=STATUS_HINT_STREAM (docs/SERVING.md).  Stream
+    # packets are transport, not state: the scheduler does not persist them
+    # (the terminal JobResult carries the full list).
+    tokens: list[int] = field(default_factory=list)
 
 
 @dataclass
@@ -683,4 +688,37 @@ def payload_batch_key(payload: Any) -> str:
         op = payload.get("op")
         if isinstance(op, str) and op in BATCHABLE_OPS:
             return op
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# serving declaration (cordum_tpu/serving)
+# ---------------------------------------------------------------------------
+
+# Ops the worker's serving engine handles: stateful autoregressive decode
+# with a per-session paged KV cache (docs/SERVING.md).  Serving ops are NOT
+# batchable ops — they join the continuous-batching decode loop instead of
+# the stateless micro-batch queues.
+SERVING_OPS = frozenset({"llm.generate"})
+
+# Session-routing label: the gateway stamps it from the payload's
+# ``session_id`` at submit, so the scheduler can route every turn of a
+# conversation to the worker holding its KV pages (session affinity,
+# generalizing LABEL_BATCH_KEY) without reading the payload behind the
+# context pointer.
+LABEL_SESSION_KEY = "cordum.session_key"
+
+# JobProgress.status_hint marking a token-stream packet: relayed to WS
+# stream consumers but never persisted as a job event (per-token events
+# would swamp the job store's event log).
+STATUS_HINT_STREAM = "stream"
+
+
+def payload_session_key(payload: Any) -> str:
+    """The session key for a serving payload (its ``session_id``), or ``""``
+    for non-serving payloads and sessionless one-shot generations."""
+    if isinstance(payload, dict) and payload.get("op") in SERVING_OPS:
+        sid = payload.get("session_id")
+        if isinstance(sid, str):
+            return sid
     return ""

@@ -2,13 +2,15 @@
 programmatic integration surface for external tools and tests.
 
 Async (httpx) with a small sync facade; covers jobs, workflows/runs,
-approvals, DLQ, artifacts, context, policy, packs.
+approvals, DLQ, artifacts, context, policy, packs, and streaming
+``llm.generate`` (docs/SERVING.md) over the gateway WS event tap.
 """
 from __future__ import annotations
 
 import asyncio
+import json
 import time
-from typing import Any, Optional
+from typing import Any, AsyncIterator, Optional
 
 import httpx
 
@@ -130,6 +132,112 @@ class Client:
 
     async def cancel_job(self, job_id: str) -> dict:
         return await self._req("POST", f"/api/v1/jobs/{job_id}/cancel")
+
+    # -- serving (llm.generate, docs/SERVING.md) ------------------------
+    async def generate(
+        self,
+        tokens: list[int],
+        *,
+        topic: str = "job.tpu.generate",
+        session_id: str = "",
+        max_new_tokens: int = 16,
+        eos_token: Optional[int] = None,
+        stream: bool = True,
+        labels: Optional[dict] = None,
+        timeout_s: float = 120.0,
+    ) -> AsyncIterator[int]:
+        """Submit an ``llm.generate`` job and yield generated tokens.
+
+        Streaming rides the gateway's ``/api/v1/stream`` WS tap: the worker
+        publishes each decode step's tokens as ``status_hint="stream"``
+        progress packets, which this helper filters by job id.  The WS is
+        opened *before* the submit so the first tokens can't be missed.
+        ``session_id`` keys the conversation: turns sharing it route to the
+        worker holding the session's KV pages (scheduler session affinity).
+
+        With ``stream=False`` (or when the WS upgrade is unavailable) the
+        helper falls back to polling the terminal result and yields the full
+        token list at once — same iterator contract, one burst."""
+        payload: dict[str, Any] = {
+            "op": "llm.generate",
+            "tokens": [int(t) for t in tokens],
+            "max_new_tokens": max_new_tokens,
+            "stream": bool(stream),
+        }
+        if session_id:
+            payload["session_id"] = session_id
+        if eos_token is not None:
+            payload["eos_token"] = int(eos_token)
+        ws = session = None
+        if stream:
+            try:
+                import aiohttp
+
+                session = aiohttp.ClientSession()
+                ws = await session.ws_connect(
+                    str(self._c.base_url).rstrip("/") + "/api/v1/stream",
+                    headers={k: v for k, v in self._c.headers.items()
+                             if k.lower().startswith("x-")},
+                    timeout=aiohttp.ClientWSTimeout(ws_close=10.0),
+                )
+            except Exception:  # noqa: BLE001 - WS is an upgrade, not a must
+                if session is not None:
+                    await session.close()
+                ws = session = None
+        try:
+            if ws is None:
+                payload["stream"] = False
+                doc = await self.submit_job(topic, payload, labels=labels)
+                final = await self.wait_job(doc["job_id"], timeout_s=timeout_s)
+                for t in self._terminal_tokens(final, doc["job_id"]):
+                    yield t
+                return
+            doc = await self.submit_job(topic, payload, labels=labels)
+            job_id = doc["job_id"]
+            n_seen = 0
+            deadline = time.monotonic() + timeout_s
+            while True:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(f"generate({job_id}) not terminal after {timeout_s}s")
+                msg = await ws.receive(timeout=left)
+                if msg.type.name not in ("TEXT", "BINARY"):
+                    # tap closed under us: finish off the terminal result
+                    final = await self.wait_job(job_id, timeout_s=max(1.0, left))
+                    for t in self._terminal_tokens(final, job_id)[n_seen:]:
+                        yield t
+                    return
+                evt = json.loads(msg.data)
+                pkt = evt.get("packet") or {}
+                pl = pkt.get("payload") or {}
+                if pl.get("job_id") != job_id:
+                    continue
+                if pkt.get("kind") == "job_progress" and pl.get("status_hint") == "stream":
+                    for t in pl.get("tokens") or []:
+                        n_seen += 1
+                        yield int(t)
+                elif pkt.get("kind") == "job_result":
+                    if pl.get("status") != "SUCCEEDED":
+                        raise ApiError(
+                            500,
+                            f"generate {job_id} {pl.get('status')}: "
+                            f"{pl.get('error_message', '')}",
+                        )
+                    # eos can land between progress packets: the terminal
+                    # result is authoritative for the tail
+                    final = await self.job_status(job_id, result=True)
+                    toks = (final.get("result") or {}).get("tokens") or []
+                    for t in toks[n_seen:]:
+                        yield int(t)
+                    return
+        finally:
+            if session is not None:
+                await session.close()
+
+    def _terminal_tokens(self, final: dict, job_id: str) -> list[int]:
+        if final.get("state") != "SUCCEEDED":
+            raise ApiError(500, f"generate {job_id} {final.get('state')}")
+        return [int(t) for t in (final.get("result") or {}).get("tokens") or []]
 
     async def remediate_job(self, job_id: str, remediation_id: str = "") -> dict:
         return await self._req("POST", f"/api/v1/jobs/{job_id}/remediate",

@@ -1,0 +1,32 @@
+"""Serving subsystem: paged KV cache + continuous batching (docs/SERVING.md).
+
+The micro-batcher (cordum_tpu/batching) coalesces *stateless* embed/infer
+jobs; user-facing LLM traffic is *autoregressive decode* with per-session
+state.  This package adds the serving path:
+
+  * :class:`PageAllocator` — block-granular KV-page bookkeeping over a
+    preallocated cache arena (page 0 reserved as the null page)
+  * :class:`LlamaServingBackend` — the XLA side: bucketed prefill +
+    one ragged paged-attention decode call per step
+  * :class:`ServingEngine` — the continuous-batching loop: admits new
+    sessions and retires finished ones every step, separates prefill from
+    the decode batch, streams tokens, frees pages on retirement/cancel
+
+``llm.generate`` jobs route here from the worker intake (see
+``worker/runtime.py``); the scheduler pins a conversation's jobs to the
+worker holding its KV pages via the ``cordum.session_key`` affinity map
+(``controlplane/scheduler/strategy.py``).
+"""
+from .backend import LlamaServingBackend
+from .engine import GenRequest, ServingEngine, ServingStats, SessionCancelled
+from .pager import CacheExhausted, PageAllocator
+
+__all__ = [
+    "CacheExhausted",
+    "GenRequest",
+    "LlamaServingBackend",
+    "PageAllocator",
+    "ServingEngine",
+    "ServingStats",
+    "SessionCancelled",
+]

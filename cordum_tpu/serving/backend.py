@@ -1,0 +1,147 @@
+"""The XLA half of the serving path: bucketed prefill + paged decode steps.
+
+One backend per worker process owns the KV-page arena (``models/llama``
+``init_kv_pages``) and the jitted entry points.  Shape discipline keeps the
+program count bounded (the batching/buckets ladder trick):
+
+  * prefill compiles one program per prompt *length bucket* (pow2 ladder);
+  * decode compiles one program per *batch bucket* — the page-table width is
+    static, so join/leave only moves a session between batch buckets.
+
+Both entry points are **blocking** (called from the worker's executor
+threads) and serialize page-arena mutations under one lock: the functional
+``.at[].set`` updates would silently drop each other's writes if a prefill
+and a decode step interleaved on the same arrays.  Phase separation is the
+engine's job (a prefill never rides *inside* a decode batch; see
+docs/SERVING.md "Prefill/decode separation").
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..batching.buckets import bucket_for, pow2_buckets
+from ..models import llama
+
+
+class LlamaServingBackend:
+    def __init__(
+        self,
+        cfg: Optional[llama.LlamaConfig] = None,
+        *,
+        num_pages: int = 128,
+        page_size: int = 16,
+        max_context: int = 0,
+        seed: int = 0,
+        params_provider: Optional[Callable[[], Any]] = None,
+    ) -> None:
+        self.cfg = cfg or llama.LlamaConfig.tiny()
+        self.page_size = max(1, page_size)
+        self.num_pages = max(2, num_pages)
+        # static page-table width: the worst-case per-sequence footprint
+        self.max_context = min(
+            max_context or self.cfg.max_seq_len, self.cfg.max_seq_len
+        )
+        self.pages_per_seq = -(-self.max_context // self.page_size)
+        self._seed = seed
+        self._params_provider = params_provider
+        self._params: Any = None
+        self._k_pages: Any = None
+        self._v_pages: Any = None
+        self._prefill_jit: Any = None
+        self._decode_jit: Any = None
+        self._prefill_buckets = pow2_buckets(8, self.max_context)
+        self._compiled_shapes: set = set()  # observability: program count
+        # page-arena mutation lock: prefill and decode both read-modify-write
+        # the K/V arrays from executor threads
+        self._dev_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def _ensure(self) -> None:
+        if self._params is not None:
+            return
+        import jax
+
+        if self._params_provider is not None:
+            self._params = self._params_provider()
+        else:
+            self._params = llama.init_params(jax.random.PRNGKey(self._seed), self.cfg)
+        self._k_pages, self._v_pages = llama.init_kv_pages(
+            self.cfg, self.num_pages, self.page_size
+        )
+        cfg = self.cfg
+        self._prefill_jit = jax.jit(lambda p, t: llama.prefill_forward(p, t, cfg))
+        self._decode_jit = jax.jit(
+            lambda p, kp, vp, toks, pos, pt: llama.decode_step(
+                p, kp, vp, toks, pos, pt, cfg
+            )
+        )
+
+    def compiled_programs(self) -> int:
+        return len(self._compiled_shapes)
+
+    def _clamp(self, row: list[int]) -> list[int]:
+        vmax = self.cfg.vocab_size - 1
+        return [min(max(0, int(t)), vmax) for t in row]
+
+    # ------------------------------------------------------------------
+    def prefill(self, prompt: list[int], pages: list[int]) -> int:
+        """Run the prompt through the full forward, write its K/V into
+        ``pages``, and return the first generated token.  Blocking; call
+        from an executor thread."""
+        import jax.numpy as jnp
+
+        self._ensure()
+        row = self._clamp(prompt)[: self.max_context]
+        t = max(1, len(row))
+        tb = bucket_for(t, self._prefill_buckets)
+        batch = np.zeros((1, tb), np.int32)
+        batch[0, : len(row)] = row
+        # position → (page, slot); the padded tail scatters to the null page
+        pos = np.arange(tb)
+        page_ids = np.zeros((tb,), np.int32)
+        page_arr = np.asarray(pages, np.int32)
+        page_ids[:t] = page_arr[pos[:t] // self.page_size]
+        slots = (pos % self.page_size).astype(np.int32)
+        self._compiled_shapes.add(("prefill", tb))
+        with self._dev_lock:
+            logits, ks, vs = self._prefill_jit(self._params, jnp.asarray(batch))
+            self._k_pages, self._v_pages = llama.scatter_prefill_kv(
+                self._k_pages, self._v_pages, ks[:, 0], vs[:, 0],
+                jnp.asarray(page_ids), jnp.asarray(slots),
+            )
+            first = int(jnp.argmax(logits[0, t - 1]))
+        return first
+
+    # ------------------------------------------------------------------
+    def decode(self, entries: list[tuple[int, int, list[int]]]) -> list[int]:
+        """One decode step for a ragged batch.
+
+        ``entries``: per-session ``(last_token, position, pages)`` where
+        ``position`` is the slot the last token occupies (== tokens cached
+        so far).  Returns one next token per entry.  Blocking; call from an
+        executor thread."""
+        import jax.numpy as jnp
+
+        self._ensure()
+        b = len(entries)
+        if b == 0:
+            return []
+        bp = 1 << (b - 1).bit_length()  # pad batch to the pow2 bucket
+        tokens = np.zeros((bp,), np.int32)
+        positions = np.zeros((bp,), np.int32)
+        tables = np.zeros((bp, self.pages_per_seq), np.int32)  # null-page fill
+        for i, (tok, pos, pages) in enumerate(entries):
+            tokens[i] = min(max(0, int(tok)), self.cfg.vocab_size - 1)
+            positions[i] = pos
+            tables[i, : len(pages)] = pages
+        self._compiled_shapes.add(("decode", bp))
+        with self._dev_lock:
+            nxt, self._k_pages, self._v_pages = self._decode_jit(
+                self._params, self._k_pages, self._v_pages,
+                jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+            )
+            out = np.asarray(nxt)[:b].tolist()
+        return out

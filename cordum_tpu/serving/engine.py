@@ -1,0 +1,449 @@
+"""The continuous-batching decode loop (the serving subsystem's scheduler).
+
+Lifecycle of an ``llm.generate`` session (docs/SERVING.md):
+
+  * :meth:`ServingEngine.submit` parks the session in the **admission
+    queue**; admission allocates its full worst-case page footprint
+    (prompt + max_new_tokens) so an admitted session can never die
+    mid-decode from cache pressure — exhaustion just delays admission;
+  * admitted sessions **prefill** off the decode path (a separate XLA call
+    on an executor thread, never inside a decode batch), bounded by
+    ``max_concurrent_prefills`` so a burst of long prompts cannot starve
+    in-flight decodes (the FlexNPU co-location policy, PAPERS.md);
+  * prefilled sessions join the **decode set**: every step assembles one
+    ragged batch from the per-session page tables, runs ONE XLA decode
+    call, scatters tokens back, admits joiners and retires finishers —
+    sessions join/leave mid-flight without perturbing each other's rows;
+  * retirement (finish / cancel / failure) frees the session's pages back
+    to the allocator and resolves the submit waiter.
+
+Token streaming rides the session's ``on_tokens`` callback (the worker
+publishes ``JobProgress`` packets with ``status_hint="stream"``); the
+terminal ``JobResult`` carries the full token list for non-streaming
+consumers.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional
+
+from ..infra import logging as logx
+from ..infra.metrics import Metrics
+from ..obs.tracer import Tracer
+from .pager import CacheExhausted, PageAllocator
+
+# on_tokens(new_tokens, n_generated, done) — the streaming sink
+TokenSink = Callable[[list[int], int, bool], Awaitable[None]]
+
+DEFAULT_MAX_SESSIONS = 8
+DEFAULT_MAX_NEW_TOKENS = 64
+
+
+class SessionCancelled(Exception):
+    """Session evicted by ``sys.job.cancel`` (queued, prefilling or
+    decoding); the worker publishes an ordinary CANCELLED result."""
+
+
+@dataclass
+class GenRequest:
+    """A decomposed ``llm.generate`` payload."""
+
+    prompt: list[int]
+    max_new_tokens: int = 16
+    session_key: str = ""
+    eos_token: Optional[int] = None
+    stream: bool = True
+
+
+@dataclass
+class ServingStats:
+    admitted: int = 0
+    retired: int = 0
+    cancelled: int = 0
+    failed: int = 0
+    steps: int = 0
+    decoded_tokens: int = 0
+    occupancy_sum: int = 0
+    max_occupancy: int = 0
+    admission_waits: int = 0  # admissions delayed by cache exhaustion
+    # per-step wall time (seconds), capped ring for p50 inter-token latency
+    step_seconds: deque = field(default_factory=lambda: deque(maxlen=4096))
+
+    @property
+    def mean_occupancy(self) -> float:
+        return self.occupancy_sum / self.steps if self.steps else 0.0
+
+
+@dataclass
+class _Session:
+    job_id: str
+    req: GenRequest
+    future: asyncio.Future
+    on_tokens: Optional[TokenSink] = None
+    trace_id: str = ""
+    parent_span_id: str = ""
+    pages: list[int] = field(default_factory=list)
+    pos: int = 0  # sequence positions cached so far
+    last_token: int = 0
+    out_tokens: list[int] = field(default_factory=list)
+    cancelled: bool = False
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def done(self) -> bool:
+        if len(self.out_tokens) >= self.req.max_new_tokens:
+            return True
+        eos = self.req.eos_token
+        return eos is not None and bool(self.out_tokens) and self.out_tokens[-1] == eos
+
+
+class ServingEngine:
+    """One per worker; owns the allocator, the session table and the loop."""
+
+    def __init__(
+        self,
+        backend: Any,
+        *,
+        run_blocking: Callable[..., Awaitable[Any]],
+        max_sessions: int = DEFAULT_MAX_SESSIONS,
+        max_new_tokens_cap: int = DEFAULT_MAX_NEW_TOKENS,
+        max_concurrent_prefills: int = 1,
+        metrics: Optional[Metrics] = None,
+        tracer: Optional[Tracer] = None,
+    ) -> None:
+        self.backend = backend
+        self.run_blocking = run_blocking  # worker.run_in_executor
+        self.max_sessions = max(1, max_sessions)
+        self.max_new_tokens_cap = max(1, max_new_tokens_cap)
+        self.max_concurrent_prefills = max(1, max_concurrent_prefills)
+        self.metrics = metrics
+        self.tracer = tracer
+        self.allocator = PageAllocator(backend.num_pages, backend.page_size)
+        self.stats = ServingStats()
+        self._pending: deque[_Session] = deque()
+        self._prefilling: dict[str, _Session] = {}
+        self._active: dict[str, _Session] = {}
+        self._prefill_tasks: set[asyncio.Task] = set()
+        self._wake = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    def parts(self, payload: Any) -> Optional[GenRequest]:
+        """Decompose a job payload; None = not a serving job (the worker
+        keeps its ordinary handler path)."""
+        from ..protocol.types import SERVING_OPS
+
+        if not isinstance(payload, dict) or payload.get("op") not in SERVING_OPS:
+            return None
+        tokens = payload.get("tokens")
+        if not (
+            isinstance(tokens, list) and tokens
+            and all(isinstance(t, int) for t in tokens)
+        ):
+            return None
+        max_new = int(payload.get("max_new_tokens", 16) or 16)
+        eos = payload.get("eos_token")
+        return GenRequest(
+            prompt=tokens,
+            max_new_tokens=max(1, min(max_new, self.max_new_tokens_cap)),
+            session_key=str(payload.get("session_id", "") or ""),
+            eos_token=int(eos) if isinstance(eos, int) else None,
+            stream=bool(payload.get("stream", True)),
+        )
+
+    # ------------------------------------------------------------------
+    @property
+    def session_count(self) -> int:
+        return len(self._pending) + len(self._prefilling) + len(self._active)
+
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    def active_sessions(self) -> int:
+        return len(self._active)
+
+    # ------------------------------------------------------------------
+    async def submit(
+        self,
+        gen: GenRequest,
+        *,
+        job_id: str,
+        trace_id: str = "",
+        parent_span_id: str = "",
+        on_tokens: Optional[TokenSink] = None,
+    ) -> dict[str, Any]:
+        """Queue a session and await its completed generation."""
+        if self._closed:
+            raise RuntimeError("serving engine is stopped")
+        footprint = self.allocator.pages_for(len(gen.prompt) + gen.max_new_tokens)
+        if footprint > self.allocator.capacity:
+            raise ValueError(
+                f"request needs {footprint} KV pages; cache holds "
+                f"{self.allocator.capacity}"
+            )
+        sess = _Session(
+            job_id=job_id, req=gen,
+            future=asyncio.get_running_loop().create_future(),
+            on_tokens=on_tokens if gen.stream else None,
+            trace_id=trace_id, parent_span_id=parent_span_id,
+        )
+        self._pending.append(sess)
+        self._ensure_loop()
+        self._wake.set()
+        tokens = await sess.future
+        return {
+            "tokens": tokens,
+            "n_tokens": len(tokens),
+            "session_key": gen.session_key,
+            "finish_reason": (
+                "eos" if gen.eos_token is not None and tokens
+                and tokens[-1] == gen.eos_token else "length"
+            ),
+        }
+
+    # ------------------------------------------------------------------
+    def cancel(self, job_id: str) -> bool:
+        """Evict a session wherever it is: admission queue (pages never
+        allocated), prefilling, or the decode set (pages freed by the loop
+        on the next tick).  Returns False when the job is not a live
+        session."""
+        for i, sess in enumerate(self._pending):
+            if sess.job_id == job_id:
+                del self._pending[i]
+                self.stats.cancelled += 1
+                if not sess.future.done():
+                    sess.future.set_exception(SessionCancelled(job_id))
+                return True
+        sess = self._prefilling.get(job_id) or self._active.get(job_id)
+        if sess is not None:
+            sess.cancelled = True  # loop/prefill task retires + frees pages
+            self._wake.set()
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.ensure_future(self._decode_loop())
+            self._loop_task.add_done_callback(self._on_loop_done)
+
+    def _on_loop_done(self, task: asyncio.Task) -> None:
+        """Decode-step failures are handled inside the loop; anything that
+        still escapes must not strand live sessions on never-resolving
+        futures — fail them loudly (each publishes an ordinary FAILED
+        result) and let the next submit restart the loop."""
+        if task.cancelled() or self._closed:
+            return
+        exc = task.exception()
+        if exc is None:
+            return
+        logx.warn("decode loop crashed; failing live sessions", err=str(exc))
+        for sess in [*self._pending, *self._prefilling.values(),
+                     *self._active.values()]:
+            self.stats.failed += 1
+            self._retire(sess, error=exc)
+        self._pending.clear()
+        self._prefilling.clear()
+
+    def _gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.serving_sessions.set(float(len(self._active)))
+            self.metrics.serving_kv_pages_in_use.set(float(self.allocator.used_pages))
+
+    def _admit(self) -> None:
+        """Move pending sessions into prefill while pages and session slots
+        allow; FIFO so exhaustion delays but never reorders admission."""
+        while (
+            self._pending
+            and len(self._prefilling) < self.max_concurrent_prefills
+            and len(self._active) + len(self._prefilling) < self.max_sessions
+        ):
+            sess = self._pending[0]
+            if sess.cancelled:
+                self._pending.popleft()
+                self._retire(sess, error=SessionCancelled(sess.job_id))
+                continue
+            footprint = self.allocator.pages_for(
+                len(sess.req.prompt) + sess.req.max_new_tokens
+            )
+            try:
+                pages = self.allocator.alloc(sess.job_id, footprint)
+            except CacheExhausted:
+                self.stats.admission_waits += 1
+                break  # head-of-line waits for a retirement to free pages
+            self._pending.popleft()
+            sess.pages = pages
+            self._prefilling[sess.job_id] = sess
+            self.stats.admitted += 1
+            if self.metrics is not None:
+                self.metrics.serving_admitted.inc()
+            t = asyncio.ensure_future(self._prefill(sess))
+            self._prefill_tasks.add(t)
+            t.add_done_callback(self._prefill_tasks.discard)
+
+    async def _prefill(self, sess: _Session) -> None:
+        try:
+            first = await self.run_blocking(
+                self.backend.prefill, sess.req.prompt, sess.pages
+            )
+        except Exception as e:  # noqa: BLE001 - surfaces as the job's failure
+            self._prefilling.pop(sess.job_id, None)
+            self.stats.failed += 1
+            self._retire(sess, error=e)
+            self._wake.set()
+            return
+        self._prefilling.pop(sess.job_id, None)
+        if sess.cancelled:
+            self._retire(sess, error=SessionCancelled(sess.job_id))
+            self._wake.set()
+            return
+        sess.pos = min(len(sess.req.prompt), self.backend.max_context)
+        sess.last_token = first
+        sess.out_tokens.append(first)
+        await self._emit(sess, [first])
+        if sess.done:
+            self._retire(sess)
+        else:
+            self._active[sess.job_id] = sess
+        self._gauge()
+        self._wake.set()
+
+    async def _emit(self, sess: _Session, new_tokens: list[int]) -> None:
+        if sess.on_tokens is None:
+            return
+        try:
+            await sess.on_tokens(new_tokens, len(sess.out_tokens), sess.done)
+        except Exception as e:  # noqa: BLE001 - streaming is best-effort
+            logx.warn("token stream sink failed", job_id=sess.job_id, err=str(e))
+
+    def _retire(self, sess: _Session, error: Optional[BaseException] = None) -> None:
+        self.allocator.free(sess.job_id)
+        self._active.pop(sess.job_id, None)
+        if error is None:
+            self.stats.retired += 1
+            if self.metrics is not None:
+                self.metrics.serving_retired.inc(reason="finished")
+            if not sess.future.done():
+                sess.future.set_result(list(sess.out_tokens))
+        else:
+            if isinstance(error, SessionCancelled):
+                self.stats.cancelled += 1
+            if self.metrics is not None:
+                self.metrics.serving_retired.inc(
+                    reason="cancelled" if isinstance(error, SessionCancelled)
+                    else "failed"
+                )
+            if not sess.future.done():
+                sess.future.set_exception(error)
+
+    # ------------------------------------------------------------------
+    async def _decode_loop(self) -> None:
+        """The continuous-batching loop: one ragged XLA call per step over
+        every active session; admission and retirement happen between
+        steps, never inside one."""
+        while not self._closed:
+            self._admit()
+            # evict cancellations before assembling the batch
+            for sess in [s for s in self._active.values() if s.cancelled]:
+                self._retire(sess, error=SessionCancelled(sess.job_id))
+            batch = list(self._active.values())
+            if not batch:
+                self._gauge()
+                if not self._pending and not self._prefilling:
+                    if self._closed:
+                        return
+                    self._wake.clear()
+                    # re-check after clear: a submit may have landed between
+                    # the emptiness check and the clear
+                    if not (self._pending or self._prefilling or self._active):
+                        await self._wake.wait()
+                else:
+                    await asyncio.sleep(0.001)  # prefill in flight: poll soon
+                continue
+            t0 = time.monotonic()
+            entries = [(s.last_token, s.pos, s.pages) for s in batch]
+            step_span = None
+            if self.tracer is not None and batch[0].trace_id:
+                oldest = min(batch, key=lambda s: s.enqueued_at)
+                step_span = self.tracer.begin(
+                    "decode-step", trace_id=oldest.trace_id,
+                    parent_span_id=oldest.parent_span_id,
+                    attrs={"occupancy": str(len(batch))},
+                )
+            try:
+                next_tokens = await self.run_blocking(self.backend.decode, entries)
+            except Exception as e:  # noqa: BLE001 - whole-step failure
+                # a poisoned step fails every rider (pages freed); the next
+                # tick starts clean — mirrors the batcher's isolation intent
+                # without re-running autoregressive state per item
+                logx.warn("decode step failed", occupancy=len(batch), err=str(e))
+                if step_span is not None and self.tracer is not None:
+                    step_span.attrs["error"] = type(e).__name__
+                    await self.tracer.finish(step_span, status="ERROR")
+                for sess in batch:
+                    self.stats.failed += 1
+                    self._retire(sess, error=e)
+                continue
+            dt = time.monotonic() - t0
+            self.stats.steps += 1
+            self.stats.decoded_tokens += len(batch)
+            self.stats.occupancy_sum += len(batch)
+            self.stats.max_occupancy = max(self.stats.max_occupancy, len(batch))
+            self.stats.step_seconds.append(dt)
+            retired_this_step = 0
+            emits = []
+            for sess, tok in zip(batch, next_tokens):
+                sess.pos += 1
+                sess.last_token = int(tok)
+                sess.out_tokens.append(int(tok))
+                emits.append(self._emit(sess, [int(tok)]))
+                if sess.done or sess.cancelled:
+                    retired_this_step += 1
+                    self._retire(
+                        sess,
+                        error=SessionCancelled(sess.job_id) if sess.cancelled else None,
+                    )
+            if emits:
+                await asyncio.gather(*emits)
+            if self.metrics is not None:
+                self.metrics.serving_batch_occupancy.observe(float(len(batch)))
+                self.metrics.serving_inter_token.observe(dt)
+            if step_span is not None and self.tracer is not None:
+                step_span.attrs["retired"] = str(retired_this_step)
+                step_span.attrs["step_ms"] = f"{dt * 1000:.2f}"
+                await self.tracer.finish(step_span)
+            self._gauge()
+            # yield to the loop so intake/cancel/heartbeat tasks run between
+            # steps even under a saturated decode set
+            await asyncio.sleep(0)
+
+    # ------------------------------------------------------------------
+    async def stop(self) -> None:
+        """Evict every session (CANCELLED) and stop the loop — worker
+        shutdown; generations are conversation turns, not batch jobs, so
+        draining them could take unboundedly long."""
+        self._closed = True
+        self._wake.set()
+        for sess in list(self._pending):
+            if not sess.future.done():
+                sess.future.set_exception(SessionCancelled(sess.job_id))
+        self._pending.clear()
+        for sess in [*self._prefilling.values(), *self._active.values()]:
+            sess.cancelled = True
+            self._retire(sess, error=SessionCancelled(sess.job_id))
+        self._prefilling.clear()
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            except Exception as e:  # noqa: BLE001 - logged, never swallowed
+                logx.warn("decode loop crashed during shutdown", err=str(e))
+            self._loop_task = None
+        for t in list(self._prefill_tasks):
+            t.cancel()

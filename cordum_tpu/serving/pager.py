@@ -1,0 +1,114 @@
+"""Block-granular KV-page allocator (the bookkeeping half of the paged cache).
+
+The arena itself (the ``[L, num_pages, page_size, kvh, hd]`` K/V arrays)
+lives in the serving backend; this allocator owns which *page indices*
+belong to which session.  Design points:
+
+  * **page 0 is the null page** — never handed out.  Padding rows of the
+    ragged decode batch and padded page-table tails point at it, so their
+    writes land in slots no live sequence attends to.
+  * **exhaustion is an admission signal, not an error path** — the serving
+    engine calls :meth:`alloc` at admission time for the session's full
+    worst-case footprint (prompt + max_new_tokens), so a session admitted
+    once can never die mid-decode from cache pressure;
+    :class:`CacheExhausted` parks the session in the admission queue.
+  * **isolation by masking, not zeroing** — freed pages return to the free
+    list dirty.  A later owner only ever attends to positions it wrote
+    (the decode mask cuts every k_pos > position), so stale data is
+    unreachable; ``tests/test_serving.py`` proves reuse never leaks across
+    sessions.
+  * single-owner, event-loop-confined: no internal locking (the serving
+    engine is the only caller and runs on the worker's loop).
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+
+class CacheExhausted(Exception):
+    """Not enough free KV pages for the requested allocation."""
+
+
+@dataclass
+class PagerStats:
+    allocs: int = 0
+    frees: int = 0
+    exhaustions: int = 0
+    peak_pages_in_use: int = 0
+
+
+class PageAllocator:
+    """Free-list allocator over ``num_pages`` arena pages of ``page_size``
+    token slots each.  Page 0 is reserved (null page)."""
+
+    NULL_PAGE = 0
+
+    def __init__(self, num_pages: int, page_size: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is the null page)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: deque[int] = deque(range(1, num_pages))
+        self._owned: dict[str, list[int]] = {}
+        self.stats = PagerStats()
+
+    # ------------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        """Usable pages (the null page is not allocatable)."""
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.capacity - len(self._free)
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` sequence positions."""
+        return max(1, -(-n_tokens // self.page_size))
+
+    def owner_pages(self, owner: str) -> list[int]:
+        return list(self._owned.get(owner, ()))
+
+    def fits(self, n_pages: int) -> bool:
+        return n_pages <= len(self._free)
+
+    # ------------------------------------------------------------------
+    def alloc(self, owner: str, n_pages: int) -> list[int]:
+        """Allocate ``n_pages`` to ``owner`` (cumulative per owner).
+
+        Raises :class:`CacheExhausted` without allocating anything when the
+        free list cannot cover the request (all-or-nothing, so a failed
+        admission never strands partial pages)."""
+        if n_pages < 1:
+            raise ValueError("n_pages must be >= 1")
+        if n_pages > len(self._free):
+            self.stats.exhaustions += 1
+            raise CacheExhausted(
+                f"{n_pages} pages requested, {len(self._free)} free "
+                f"(capacity {self.capacity})"
+            )
+        pages = [self._free.popleft() for _ in range(n_pages)]
+        self._owned.setdefault(owner, []).extend(pages)
+        self.stats.allocs += 1
+        self.stats.peak_pages_in_use = max(
+            self.stats.peak_pages_in_use, self.used_pages
+        )
+        return pages
+
+    def free(self, owner: str) -> int:
+        """Return every page owned by ``owner`` to the free list; returns
+        the count (0 for an unknown owner — freeing twice is a no-op, not
+        an error, because cancel and retirement can race benignly)."""
+        pages = self._owned.pop(owner, None)
+        if not pages:
+            return 0
+        self._free.extend(pages)
+        self.stats.frees += 1
+        return len(pages)

@@ -253,6 +253,19 @@ def make_tpu_handlers(compute: TPUCompute):
                     compute.infer, tokens, payload.get("max_len"), timer=ctx.device_timer
                 )
             )
+        if op == "llm.generate":
+            # serving jobs route through the worker's serving engine BEFORE
+            # the handler path (runtime._on_job); landing here means the
+            # engine is not attached or the payload shape is invalid
+            serving = ctx.worker.serving
+            if serving is None:
+                raise HandlerError(
+                    "llm.generate requires the serving engine (WORKER_SERVING=1)"
+                )
+            raise HandlerError(
+                "llm.generate requires tokens: non-empty list[int] "
+                "(plus optional session_id/max_new_tokens/eos_token/stream)"
+            )
         if op == "train":
             import asyncio
 
@@ -367,6 +380,45 @@ def make_micro_batcher(
     )
 
 
+def make_serving_engine(
+    compute: TPUCompute,
+    worker: Worker,
+    *,
+    cache_pages: int = 128,
+    page_size: int = 16,
+    max_sessions: int = 8,
+    max_new_tokens: int = 64,
+    max_concurrent_prefills: int = 1,
+    metrics=None,
+):
+    """Build the worker's continuous-batching serving engine over a paged
+    Llama backend that shares ``compute``'s model params (one copy of the
+    weights per worker process; the KV page arena is the serving addition).
+    """
+    from ..serving.backend import LlamaServingBackend
+    from ..serving.engine import ServingEngine
+
+    def params_provider():
+        compute._ensure_llama()
+        return compute._llama_params
+
+    backend = LlamaServingBackend(
+        compute.llama_cfg,
+        num_pages=cache_pages,
+        page_size=page_size,
+        params_provider=params_provider,
+    )
+    return ServingEngine(
+        backend,
+        run_blocking=worker.run_in_executor,
+        max_sessions=max_sessions,
+        max_new_tokens_cap=max_new_tokens,
+        max_concurrent_prefills=max_concurrent_prefills,
+        metrics=metrics,
+        tracer=worker.tracer,
+    )
+
+
 def attach_default_tpu_worker(
     worker: Worker,
     *,
@@ -374,17 +426,31 @@ def attach_default_tpu_worker(
     batching: bool = True,
     max_batch_rows: int = 32,
     max_batch_wait_ms: float = 25.0,
+    serving: bool = True,
+    serving_cache_pages: int = 128,
+    serving_page_size: int = 16,
+    serving_max_sessions: int = 8,
+    serving_max_new_tokens: int = 64,
     metrics=None,
     **kw,
 ) -> TPUCompute:
     """Wire the standard TPU op handlers (and, by default, the micro-batcher
-    over the batchable ops) onto a worker."""
+    over the batchable ops plus the llm.generate serving engine) onto a
+    worker."""
     compute = TPUCompute(tp=tp, **kw)
     worker.register_default(make_tpu_handlers(compute))
     if batching:
         worker.attach_batcher(make_micro_batcher(
             compute, worker,
             max_batch_rows=max_batch_rows, max_wait_ms=max_batch_wait_ms,
+            metrics=metrics,
+        ))
+    if serving:
+        worker.attach_serving(make_serving_engine(
+            compute, worker,
+            cache_pages=serving_cache_pages, page_size=serving_page_size,
+            max_sessions=serving_max_sessions,
+            max_new_tokens=serving_max_new_tokens,
             metrics=metrics,
         ))
     return compute

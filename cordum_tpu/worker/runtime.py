@@ -45,8 +45,10 @@ from ..protocol.types import (
     JobResult,
     JobState,
     LABEL_PARTITION,
+    STATUS_HINT_STREAM,
     Span,
 )
+from ..serving.engine import GenRequest, ServingEngine, SessionCancelled
 from ..utils.ids import new_id
 
 HEARTBEAT_INTERVAL_S = 10.0
@@ -147,6 +149,11 @@ class Worker:
         # optional micro-batcher (cordum_tpu/batching): batchable jobs bypass
         # the per-job semaphore and coalesce into bucketed XLA calls
         self._batcher: Optional[MicroBatcher] = None
+        # optional serving engine (cordum_tpu/serving): llm.generate jobs
+        # bypass the semaphore too — the engine's admission control (page
+        # budget + max_sessions) bounds concurrency, and a session parked in
+        # the decode loop must not starve the per-job lanes
+        self._serving: Optional[ServingEngine] = None
         self._telemetry = _device_telemetry()
         self._busy_since: Optional[float] = None
         self._busy_accum = 0.0
@@ -171,6 +178,16 @@ class Worker:
     @property
     def batcher(self) -> Optional[MicroBatcher]:
         return self._batcher
+
+    def attach_serving(self, serving: ServingEngine) -> None:
+        """Wire a serving engine between job intake and the decode loop.
+        Jobs whose payload it recognizes (``serving.parts``) become decode
+        sessions; everything else keeps the per-job handler path."""
+        self._serving = serving
+
+    @property
+    def serving(self) -> Optional[ServingEngine]:
+        return self._serving
 
     async def run_in_executor(self, fn, *args):
         """Run a blocking JAX computation off the event loop."""
@@ -201,6 +218,8 @@ class Worker:
         self._subs = []
         if self._batcher is not None:
             await self._batcher.stop()  # drain queued batches before the pool dies
+        if self._serving is not None:
+            await self._serving.stop()  # evict sessions (they publish CANCELLED)
         self._executor.shutdown(wait=False)
 
     # ------------------------------------------------------------------
@@ -215,6 +234,11 @@ class Worker:
             # in the flush; its waiter raises BatchCancelled and the job
             # publishes an ordinary CANCELLED result
             self._batcher.cancel(c.job_id)
+        if self._serving is not None:
+            # stateful cancel: evict the session from the decode loop (or
+            # the admission queue) and free its KV pages; its waiter raises
+            # SessionCancelled → ordinary CANCELLED result
+            self._serving.cancel(c.job_id)
 
     async def _on_job(self, subject: str, pkt: BusPacket) -> None:
         req = pkt.job_request
@@ -222,23 +246,28 @@ class Worker:
             return
         payload: Any = _UNFETCHED
         batch_parts: Optional[BatchParts] = None
+        gen_req: Optional[GenRequest] = None
         if (
-            self._batcher is not None
+            (self._batcher is not None or self._serving is not None)
             and req.job_id not in self._active
             and req.job_id not in self._completed
-            # explicit topic/adapter handlers win over the batch path
+            # explicit topic/adapter handlers win over the batch/serving path
             and self._handlers.get(req.topic) is None
             and self._handlers.get(req.adapter_id) is None
         ):
             payload = await self.store.get_pointer(req.context_ptr) if req.context_ptr else None
-            batch_parts = self._batcher.parts(payload)
-        if batch_parts is not None:
-            # batchable: no semaphore slot — a queued job must not starve the
-            # per-job lanes while it waits for batch-mates; the batcher's
-            # window + the executor pool bound the actual device concurrency
+            if self._batcher is not None:
+                batch_parts = self._batcher.parts(payload)
+            if batch_parts is None and self._serving is not None:
+                gen_req = self._serving.parts(payload)
+        if batch_parts is not None or gen_req is not None:
+            # batchable/serving: no semaphore slot — a queued job must not
+            # starve the per-job lanes while it waits for batch-mates (or
+            # sits in the decode loop); the batcher's window / the serving
+            # engine's admission control bound the actual device concurrency
             await self._run_job(
                 req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id,
-                payload=payload, batch_parts=batch_parts,
+                payload=payload, batch_parts=batch_parts, gen_req=gen_req,
             )
             return
         async with self._sem:
@@ -254,6 +283,7 @@ class Worker:
         parent_span_id: str = "",
         payload: Any = _UNFETCHED,
         batch_parts: Optional[BatchParts] = None,
+        gen_req: Optional[GenRequest] = None,
     ) -> None:
         if req.job_id in self._active:
             return  # redelivery of an in-flight job
@@ -287,7 +317,20 @@ class Worker:
         error_code = error_message = ""
         result_ptr = ""
         try:
-            if batch_parts is not None and self._batcher is not None:
+            if gen_req is not None and self._serving is not None:
+                # serving path: park as a decode session; the continuous-
+                # batching loop streams tokens via progress packets and the
+                # terminal result carries the full list
+                exec_span.attrs["serving"] = "true"
+                out = await self._serving.submit(
+                    gen_req,
+                    job_id=req.job_id,
+                    trace_id=trace_id,
+                    parent_span_id=exec_span.span_id,
+                    on_tokens=self._token_sink(req.job_id, gen_req),
+                )
+                exec_span.attrs["n_tokens"] = str(out.get("n_tokens", 0))
+            elif batch_parts is not None and self._batcher is not None:
                 # micro-batch path: park in the (op, bucket) queue and await
                 # the scattered slice of the flushed XLA call.  The flush
                 # writes batch_size / batch_queue_wait_ms straight into the
@@ -319,7 +362,7 @@ class Worker:
                         out = await out
             if out is not None:
                 result_ptr = await self.store.put_result(req.job_id, out)
-        except (JobCancelled, BatchCancelled):
+        except (JobCancelled, BatchCancelled, SessionCancelled):
             status = JobState.CANCELLED.value
             error_code, error_message = "CANCELLED", "cancelled"
         except asyncio.CancelledError:
@@ -380,6 +423,32 @@ class Worker:
         return subj.stamped_result_subject((req.labels or {}).get(LABEL_PARTITION, ""))
 
     # ------------------------------------------------------------------
+    def _token_sink(self, job_id: str, gen: GenRequest):
+        """The serving engine's streaming callback: each decode step's new
+        tokens ride a JobProgress packet with ``status_hint="stream"`` —
+        relayed to WS consumers by the gateway tap, skipped by the
+        scheduler's event persistence."""
+        if not gen.stream:
+            return None
+        total = max(1, gen.max_new_tokens)
+
+        async def sink(new_tokens: list[int], n_generated: int, done: bool) -> None:
+            await self.bus.publish(
+                subj.PROGRESS,
+                BusPacket.wrap(
+                    JobProgress(
+                        job_id=job_id,
+                        percent=min(100.0, 100.0 * n_generated / total),
+                        status_hint=STATUS_HINT_STREAM,
+                        worker_id=self.worker_id,
+                        tokens=list(new_tokens),
+                    ),
+                    sender_id=self.worker_id,
+                ),
+            )
+
+        return sink
+
     async def publish_progress(self, job_id: str, percent: float, message: str = "") -> None:
         await self.bus.publish(
             subj.PROGRESS,

@@ -290,7 +290,9 @@ def test_floor_checker_passes_healthy_doc():
     doc = {"value": 2600.0, "selections_per_sec": 90000.0,
            "kv_roundtrips_per_job": 3.0, "statebus_kv_roundtrips_per_job": 8.0,
            "statebus_pipeline_speedup": 1.9,
-           "sharded_jobs_per_sec": 300.0, "sharded_single_jobs_per_sec": 320.0}
+           "sharded_jobs_per_sec": 300.0, "sharded_single_jobs_per_sec": 320.0,
+           "serving_speedup": 4.5, "serving_affinity_hit_rate": 1.0,
+           "decode_tokens_per_sec": 2900.0}
     floors = json.loads((REPO / "bench_floor.json").read_text())
     assert mod.check(doc, floors) == []
 
@@ -303,7 +305,9 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
     doc = {"value": 100.0, "selections_per_sec": 90000.0,
            "kv_roundtrips_per_job": 3.0, "statebus_kv_roundtrips_per_job": 8.0,
            "statebus_pipeline_speedup": 1.9,
-           "sharded_jobs_per_sec": 300.0, "sharded_single_jobs_per_sec": 320.0}
+           "sharded_jobs_per_sec": 300.0, "sharded_single_jobs_per_sec": 320.0,
+           "serving_speedup": 4.5, "serving_affinity_hit_rate": 1.0,
+           "decode_tokens_per_sec": 2900.0}
     violations = mod.check(doc, floors)
     assert violations and "value" in violations[0]
     # ceilings guard the other direction (round-trip budget regression)
