@@ -121,6 +121,10 @@ class ServingEngine:
         self.max_concurrent_prefills = max(1, max_concurrent_prefills)
         self.metrics = metrics
         self.tracer = tracer
+        # the backend's static page-table width caps a session's lifetime
+        # footprint; anything longer must be rejected at submit (the arena
+        # may hold far more pages than one table row can address)
+        self.max_context = int(getattr(backend, "max_context", 0) or 0)
         self.allocator = PageAllocator(backend.num_pages, backend.page_size)
         self.stats = ServingStats()
         self._pending: deque[_Session] = deque()
@@ -145,7 +149,12 @@ class ServingEngine:
             and all(isinstance(t, int) for t in tokens)
         ):
             return None
-        max_new = int(payload.get("max_new_tokens", 16) or 16)
+        try:
+            max_new = int(payload.get("max_new_tokens", 16) or 16)
+        except (TypeError, ValueError):
+            # malformed payload is not a session: fall through to the
+            # handler path, which raises the op's own descriptive error
+            return None
         eos = payload.get("eos_token")
         return GenRequest(
             prompt=tokens,
@@ -179,7 +188,17 @@ class ServingEngine:
         """Queue a session and await its completed generation."""
         if self._closed:
             raise RuntimeError("serving engine is stopped")
-        footprint = self.allocator.pages_for(len(gen.prompt) + gen.max_new_tokens)
+        total = len(gen.prompt) + gen.max_new_tokens
+        if self.max_context and total > self.max_context:
+            # beyond the backend's static page-table width: prefill would
+            # silently truncate and the first decode step would poison the
+            # whole batch — fail this job alone, before it becomes a session
+            raise ValueError(
+                f"request spans {total} tokens (prompt {len(gen.prompt)} + "
+                f"{gen.max_new_tokens} new); backend max_context is "
+                f"{self.max_context}"
+            )
+        footprint = self.allocator.pages_for(total)
         if footprint > self.allocator.capacity:
             raise ValueError(
                 f"request needs {footprint} KV pages; cache holds "
@@ -214,9 +233,9 @@ class ServingEngine:
         for i, sess in enumerate(self._pending):
             if sess.job_id == job_id:
                 del self._pending[i]
-                self.stats.cancelled += 1
-                if not sess.future.done():
-                    sess.future.set_exception(SessionCancelled(job_id))
+                # _retire keeps stats and the retirement metric in step
+                # (pages were never allocated; free() is a no-op here)
+                self._retire(sess, error=SessionCancelled(job_id))
                 return True
         sess = self._prefilling.get(job_id) or self._active.get(job_id)
         if sess is not None:

@@ -271,8 +271,9 @@ async def test_engine_admission_queue_on_exhaustion():
     assert eng.stats.admission_waits > 0  # the queue actually formed
     assert max(be.decode_batches) == 1  # pages, not slots, were the limit
     # an accepted-but-impossible footprint is rejected upfront, not queued
-    with pytest.raises(ValueError):
-        await eng.submit(GenRequest(prompt=[1] * 30, max_new_tokens=40),
+    # (20 tokens fit the page-table width but need 5 of the 4 usable pages)
+    with pytest.raises(ValueError, match="KV pages"):
+        await eng.submit(GenRequest(prompt=[1] * 12, max_new_tokens=8),
                          job_id="huge")
     await eng.stop()
 
@@ -292,7 +293,7 @@ async def test_engine_eos_stops_early():
 
 
 async def test_engine_cancel_pending_and_active_frees_pages():
-    be = FakeBackend(num_pages=64, step_delay=0.02)
+    be = FakeBackend(num_pages=64, max_context=512, step_delay=0.02)
     eng = ServingEngine(be, run_blocking=run_blocking, max_sessions=4,
                         max_new_tokens_cap=600)
     live = asyncio.ensure_future(eng.submit(
@@ -318,8 +319,84 @@ async def test_engine_cancel_pending_and_active_frees_pages():
     await eng.stop()
 
 
+async def test_engine_rejects_over_context_request_without_killing_batch():
+    """A request longer than the backend's static page-table width fails
+    alone at submit — it must never become a session, where its first decode
+    step would raise and retire every in-flight conversation on the worker."""
+    be = FakeBackend(num_pages=256, page_size=4, max_context=32,
+                     step_delay=0.005)
+    eng = ServingEngine(be, run_blocking=run_blocking, max_sessions=8,
+                        max_new_tokens_cap=600)
+    live = asyncio.ensure_future(eng.submit(
+        GenRequest(prompt=[1, 2, 3], max_new_tokens=12, stream=False),
+        job_id="live"))
+    for _ in range(200):
+        await asyncio.sleep(0.005)
+        if eng.active_sessions() == 1:
+            break
+    assert eng.active_sessions() == 1
+    # the arena has room (10 of 255 pages) — only the table width bars it
+    assert eng.allocator.pages_for(40) <= eng.allocator.free_pages
+    with pytest.raises(ValueError, match="max_context"):
+        await eng.submit(GenRequest(prompt=[9] * 20, max_new_tokens=20,
+                                    stream=False), job_id="huge")
+    # the in-flight session is untouched by the rejection
+    out = await asyncio.wait_for(live, timeout=10)
+    assert out["tokens"] == fake_ref([1, 2, 3], 12)
+    assert eng.stats.failed == 0
+    await eng.stop()
+
+
+async def test_engine_cancel_pending_counts_in_retirement_metric():
+    """Cancelling a still-queued session moves the retirement metric the
+    same way the prefilling/decoding cancel paths do (both ride _retire)."""
+    from cordum_tpu.infra.metrics import Metrics
+
+    metrics = Metrics()
+    be = FakeBackend(num_pages=64, max_context=512, step_delay=0.02)
+    eng = ServingEngine(be, run_blocking=run_blocking, max_sessions=1,
+                        max_new_tokens_cap=600, metrics=metrics)
+    live = asyncio.ensure_future(eng.submit(
+        GenRequest(prompt=[1], max_new_tokens=100, stream=False),
+        job_id="live"))
+    for _ in range(200):
+        await asyncio.sleep(0.01)
+        if eng.active_sessions() == 1:
+            break
+    assert eng.active_sessions() == 1
+    queued = asyncio.ensure_future(eng.submit(
+        GenRequest(prompt=[2], max_new_tokens=4, stream=False),
+        job_id="queued"))
+    for _ in range(100):
+        await asyncio.sleep(0.005)
+        if eng.queue_depth() == 1:
+            break
+    assert eng.queue_depth() == 1  # parked behind max_sessions=1
+    assert eng.cancel("queued") is True
+    with pytest.raises(SessionCancelled):
+        await asyncio.wait_for(queued, timeout=5)
+    assert eng.stats.cancelled == 1
+    assert metrics.serving_retired.value(reason="cancelled") == 1
+    assert eng.cancel("live") is True
+    with pytest.raises(SessionCancelled):
+        await asyncio.wait_for(live, timeout=10)
+    assert metrics.serving_retired.value(reason="cancelled") == 2
+    await eng.stop()
+
+
+async def test_parts_tolerates_malformed_max_new_tokens():
+    """A non-numeric max_new_tokens is not a session: parts() returns None
+    so the job falls through to the handler path's descriptive failure."""
+    eng = ServingEngine(FakeBackend(), run_blocking=run_blocking)
+    good = {"op": "llm.generate", "tokens": [1, 2]}
+    assert eng.parts(good) is not None
+    for bad in ("abc", [16], {"n": 16}, "12.5"):
+        assert eng.parts({**good, "max_new_tokens": bad}) is None, bad
+    await eng.stop()
+
+
 async def test_engine_stop_evicts_everything():
-    be = FakeBackend(num_pages=64, step_delay=0.02)
+    be = FakeBackend(num_pages=64, max_context=512, step_delay=0.02)
     eng = ServingEngine(be, run_blocking=run_blocking, max_sessions=2,
                         max_new_tokens_cap=600)
     futs = [asyncio.ensure_future(eng.submit(
@@ -518,7 +595,9 @@ async def test_worker_cancel_inflight_generate_frees_pages():
 
     kv, bus, js, ms, eng = make_stack()
     await eng.start()
-    w = make_serving_worker(bus, ms, backend=FakeBackend(num_pages=64, step_delay=0.02),
+    w = make_serving_worker(bus, ms,
+                            backend=FakeBackend(num_pages=64, max_context=512,
+                                                step_delay=0.02),
                             max_sessions=4, max_new_tokens_cap=600)
     await w.start()
     await settle(bus)
@@ -561,15 +640,23 @@ async def test_worker_invalid_generate_payload_fails_pointedly():
     w = make_serving_worker(bus, ms)
     await w.start()
     await settle(bus)
-    ptr = await ms.put_context("gbad", {"op": "llm.generate", "tokens": "oops"})
-    await bus.publish(subj.SUBMIT, BusPacket.wrap(
-        JobRequest(job_id="gbad", topic="job.tpu.generate", context_ptr=ptr)))
+    bad = {
+        "gbad": {"op": "llm.generate", "tokens": "oops"},
+        "gbad2": {"op": "llm.generate", "tokens": [1, 2],
+                  "max_new_tokens": "lots"},
+    }
+    for jid, payload in bad.items():
+        ptr = await ms.put_context(jid, payload)
+        await bus.publish(subj.SUBMIT, BusPacket.wrap(
+            JobRequest(job_id=jid, topic="job.tpu.generate", context_ptr=ptr)))
     for _ in range(100):
         await settle(bus)
-        if await js.get_state("gbad") == "FAILED":
+        states = [await js.get_state(j) for j in bad]
+        if all(s == "FAILED" for s in states):
             break
-    meta = await js.get_meta("gbad")
-    assert meta["state"] == "FAILED" and "tokens" in meta["error_message"]
+    for jid in bad:
+        meta = await js.get_meta(jid)
+        assert meta["state"] == "FAILED" and "tokens" in meta["error_message"]
     assert w.serving.stats.admitted == 0
     await w.stop()
     await eng.stop()
