@@ -263,10 +263,17 @@ class _PerOpPipelineKV:
         return True, {k: await kv.version(k) for k in watches}
 
 
-async def bench_statebus(pipelined: bool, n_jobs: int) -> dict:
+async def bench_statebus(pipelined: bool, n_jobs: int, *,
+                         replicated: bool = False) -> dict:
     """The schedule loop against a REAL TCP StateBusServer (the deployment
     the pipelining work targets): scheduler and worker hold separate
-    connections, every KV op is a genuine wire round trip."""
+    connections, every KV op is a genuine wire round trip.
+
+    ``replicated`` attaches a replica SUBPROCESS (async ack mode) tailing
+    the primary's committed-record stream, so the reported throughput
+    carries the full replication cost — frame fan-out on the primary plus
+    a competing apply/ack process (ISSUE 8; ceiling in bench_floor.json).
+    """
     from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
     from cordum_tpu.controlplane.scheduler.engine import Engine
     from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
@@ -281,6 +288,19 @@ async def bench_statebus(pipelined: bool, n_jobs: int) -> dict:
     srv = StateBusServer(port=0)
     await srv.start()
     url = f"statebus://127.0.0.1:{srv.port}"
+    replica_child = None
+    if replicated:
+        rport = _free_ports(1)[0]
+        me = os.path.abspath(__file__)
+        replica_child = subprocess.Popen(
+            [sys.executable, me, "--statebus-child", str(rport), url],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        deadline = time.monotonic() + 60
+        while not srv.repl.sessions:
+            if time.monotonic() > deadline:
+                replica_child.kill()
+                raise TimeoutError("bench replica never attached")
+            await asyncio.sleep(0.05)
     skv, sbus, sconn = await connect(url)  # scheduler "process"
     wkv, wbus, wconn = await connect(url)  # worker "process"
     try:
@@ -330,15 +350,60 @@ async def bench_statebus(pipelined: bool, n_jobs: int) -> dict:
         n = eng.metrics.jobs_completed.value(status="SUCCEEDED")
         roundtrips = eng.metrics.kv_roundtrips.total()
         await eng.stop()
-        return {
+        out = {
             "jobs": int(n),
             "jobs_per_sec": n / dt if dt > 0 else 0.0,
             "kv_roundtrips_per_job": roundtrips / n if n else 0.0,
         }
+        if replicated:
+            # end-of-run lag: how far the replica trails when the burst ends
+            # (async mode's loss window if the primary died right now)
+            out["repl_lag_ops_end"] = max(
+                (srv.repl.offset - s.acked_offset
+                 for s in srv.repl.sessions.values()), default=-1)
+        return out
     finally:
         await sconn.close()
         await wconn.close()
         await srv.stop()
+        if replica_child is not None:
+            replica_child.terminate()
+            try:
+                replica_child.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                replica_child.kill()
+
+
+def bench_replication_overhead(pairs: int = 5) -> dict:
+    """Async-replication cost on the statebus schedule loop (ISSUE 8).
+
+    Runs ``pairs`` interleaved (plain, replicated) pipelined runs at the
+    FULL statebus job count — short smoke-sized runs put startup noise in
+    the same decade as the effect — and reports the MEDIAN same-run
+    overhead ratio, so one scheduler hiccup on a shared 1-2 core CI runner
+    can't fake (or mask) a regression.  The replica is a real subprocess
+    tailing the primary's committed-record stream with async acks.
+    """
+    import statistics
+
+    overheads, plain_rates, repl_rates, lag_end = [], [], [], 0
+    for _ in range(pairs):
+        plain = asyncio.run(bench_statebus(True, STATEBUS_JOBS))
+        repl = asyncio.run(bench_statebus(True, STATEBUS_JOBS, replicated=True))
+        plain_rates.append(plain["jobs_per_sec"])
+        repl_rates.append(repl["jobs_per_sec"])
+        lag_end = max(lag_end, repl.get("repl_lag_ops_end", -1))
+        if plain["jobs_per_sec"]:
+            overheads.append(
+                100.0 * (1.0 - repl["jobs_per_sec"] / plain["jobs_per_sec"]))
+    return {
+        "statebus_replicated_jobs_per_sec": round(
+            statistics.median(repl_rates), 1) if repl_rates else 0.0,
+        "statebus_replication_overhead_pct": round(
+            statistics.median(overheads), 1) if overheads else 100.0,
+        "statebus_replication_overhead_runs": [round(o, 1) for o in overheads],
+        "statebus_replication_lag_ops_end": lag_end,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -368,12 +433,14 @@ async def _wait_for_stop() -> None:
     await stop.wait()
 
 
-def _statebus_child(port: int) -> None:
-    """One statebus partition server process."""
+def _statebus_child(port: int, replica_of: str = "") -> None:
+    """One statebus partition server process (optionally a replica tailing
+    ``replica_of`` — the --replicated bench topology)."""
     async def run() -> None:
         from cordum_tpu.infra.statebus import StateBusServer
 
-        srv = StateBusServer(port=port)
+        srv = StateBusServer(port=port, replica_of=replica_of,
+                             auto_promote=False)
         await srv.start()
         await _wait_for_stop()
         await srv.stop()
@@ -1212,7 +1279,17 @@ def main() -> None:
         _jax_child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--statebus-child":
-        _statebus_child(int(sys.argv[2]))
+        _statebus_child(int(sys.argv[2]),
+                        sys.argv[3] if len(sys.argv) > 3 else "")
+        return
+    if "--replicated" in sys.argv:
+        # statebus replication overhead mode (ISSUE 8): one JSON line, keys
+        # match the full bench's statebus section so bench_floor.json gates
+        # both surfaces identically.
+        out = {"metric": "statebus_replication_overhead_pct", "unit": "%"}
+        out.update(bench_replication_overhead())
+        out["value"] = out["statebus_replication_overhead_pct"]
+        print(json.dumps(out))
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--shard-child":
         _shard_child(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
@@ -1247,6 +1324,7 @@ def main() -> None:
     lat = asyncio.run(bench_latency())
     sb_pipe = asyncio.run(bench_statebus(True, sb_jobs))
     sb_perop = asyncio.run(bench_statebus(False, sb_jobs))
+    sb_repl = bench_replication_overhead()
     sharded = asyncio.run(bench_sharded(shards, SB_PARTITIONS, sh_jobs))
     sharded_single = asyncio.run(bench_sharded(1, 1, sh_jobs))
     sel = bench_selection()
@@ -1272,6 +1350,11 @@ def main() -> None:
         "statebus_unpipelined_kv_roundtrips_per_job": round(
             sb_perop["kv_roundtrips_per_job"], 1
         ),
+        # replication overhead (ISSUE 8): median over interleaved
+        # plain/replicated pairs with a live replica subprocess tailing the
+        # primary (async acks); same-run ratios so host speed cancels
+        # (ceiling in bench_floor.json)
+        **sb_repl,
         # keyspace-sharded control plane (ISSUE 5): S scheduler-shard
         # processes over P statebus partition processes, vs the same
         # multi-process harness at 1×1

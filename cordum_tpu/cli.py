@@ -276,6 +276,66 @@ def cmd_pack(args) -> None:
     cli_pack(args)
 
 
+def cmd_statebus(args) -> None:
+    """Statebus fleet status/admin, straight against the servers (no
+    gateway): per-partition role/epoch/offset/replication lag from
+    CORDUM_STATEBUS_URL (comma = partitions, ``|`` = replica set), and
+    explicit replica promotion (docs/PROTOCOL.md §Replication)."""
+    import asyncio
+
+    from .infra.replication import admin_call, parse_endpoint
+
+    url = args.url or os.environ.get(
+        "CORDUM_STATEBUS_URL", "statebus://127.0.0.1:7420")
+    partitions = [u.strip() for u in url.split(",") if u.strip()]
+
+    async def run() -> None:
+        if args.action == "promote":
+            if not args.endpoint:
+                _die("statebus promote requires an endpoint (host:port)")
+            host, port = parse_endpoint(args.endpoint)
+            doc = await admin_call(host, port, "promote", timeout_s=10.0)
+            if doc is None:
+                _die(f"promote failed: {host}:{port} unreachable or errored")
+            _print(doc)
+            return
+        rows = []
+        for p, part in enumerate(partitions):
+            for ep in part.split("|"):
+                host, port = parse_endpoint(ep.strip())
+                doc = await admin_call(host, port, "role", timeout_s=2.0)
+                row = {"partition": p, "endpoint": f"{host}:{port}"}
+                if not isinstance(doc, dict):
+                    row.update({"role": "DOWN", "epoch": "-", "offset": "-",
+                                "lag_ops": "-"})
+                else:
+                    lag = doc.get("lag_ops")  # replica-side link lag
+                    if lag is None and doc.get("replicas"):
+                        # primary: worst attached-replica lag
+                        lag = max(r.get("lag_ops", 0) for r in doc["replicas"])
+                    row.update({
+                        "role": doc.get("role", "?"),
+                        "epoch": doc.get("epoch", 0),
+                        "offset": doc.get("offset", 0),
+                        "lag_ops": 0 if lag is None else lag,
+                        "sync": doc.get("sync", False),
+                        "replicas": len(doc.get("replicas") or []),
+                    })
+                rows.append(row)
+        if args.json:
+            _print(rows)
+            return
+        cols = ["partition", "endpoint", "role", "epoch", "offset",
+                "lag_ops", "sync", "replicas"]
+        widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+                  for c in cols}
+        print("  ".join(c.ljust(widths[c]) for c in cols))
+        for r in rows:
+            print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
+
+    asyncio.run(run())
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="cordumctl", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -342,6 +402,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true", help="raw JSON instead of ASCII")
     sp.add_argument("--width", type=int, default=48)
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser(
+        "statebus", help="statebus replication status / promote a replica")
+    sp.add_argument("action", choices=["status", "promote"])
+    sp.add_argument("endpoint", nargs="?", default="",
+                    help="endpoint for promote (statebus://host:port)")
+    sp.add_argument("--url", default="",
+                    help="override CORDUM_STATEBUS_URL (comma = partitions, "
+                         "'|' = replica set)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_statebus)
 
     sp = sub.add_parser("pack")
     sp.add_argument("action", choices=["create", "install", "uninstall", "list", "show", "verify"])

@@ -625,7 +625,7 @@ class Engine:
         # dispatch: publishes overlap each other AND the grouped
         # DISPATCHED→RUNNING bookkeeping commit (same contract as the
         # per-job path: an undelivered publish leaves the job RUNNING for
-        # the reconciler's running-timeout to recover)
+        # the replayer's result-replay nudge to recover)
         d_spans = []
         pubs = []
         for it in live:
@@ -974,9 +974,9 @@ class Engine:
             # Overlap the load-bearing dispatch publish with the
             # non-load-bearing DISPATCHED→RUNNING bookkeeping commit (one
             # pipelined chain).  If the publish fails the chain may still
-            # land, leaving the job RUNNING-but-undelivered; the
-            # reconciler's running-timeout recovers it, and the publish
-            # error still propagates for bus-level redelivery.
+            # land, leaving the job RUNNING-but-undelivered; the replayer's
+            # result-replay nudge recovers it, and the publish error still
+            # propagates for bus-level redelivery.
             results = await asyncio.gather(
                 self.bus.publish(target, out),
                 self.job_store.apply_chain(
@@ -1062,6 +1062,34 @@ class Engine:
             return True
         finally:
             await self.job_store.release_job_lock(job_id, self.instance_id)
+
+    async def nudge_inflight(self, job_id: str) -> bool:
+        """Re-deliver a job wedged in DISPATCHED/RUNNING to its recorded
+        dispatch subject.  The worker side is idempotent — an in-flight
+        redelivery is dropped, a completed job republishes its cached
+        result — so this acts as a result-replay request: it recovers jobs
+        whose dispatch packet or terminal result was lost to a statebus
+        failover window (pub/sub pushes are not replicated), without
+        re-running work or transitioning state.  Driven by the
+        PendingReplayer past ``Timeouts.result_replay_s``."""
+        snap = await self.job_store.watch_meta(job_id)
+        if snap.state not in (JobState.DISPATCHED.value, JobState.RUNNING.value):
+            return False
+        req = await self.job_store.get_request(job_id)
+        if req is None:
+            return False
+        target = snap.get("dispatch_subject", "") or self.strategy.pick_subject(req)
+        # fresh bus msg-id: the redelivery must survive the dedupe window
+        req.labels = dict(req.labels or {})
+        req.labels["cordum.bus_msg_id"] = f"nudge-{job_id}-{now_us()}"
+        self._stamp_partition(req)
+        await self.bus.publish(
+            target,
+            BusPacket.wrap(req, trace_id=snap.get("trace_id", ""),
+                           sender_id=self.instance_id),
+        )
+        self.metrics.inflight_nudges.inc()
+        return True
 
     # ------------------------------------------------------------------
     async def _check_safety(self, req: JobRequest):

@@ -195,4 +195,22 @@ class PendingReplayer:
                     n += 1
             except Exception:
                 logx.warn("redispatch failed", job_id=job_id)
+        # DISPATCHED/RUNNING past the result-replay window: the dispatch
+        # packet or its terminal result may have been lost to a statebus
+        # failover (pub/sub pushes are not replicated) — re-deliver to the
+        # worker, whose idempotence turns the nudge into "republish your
+        # result" (or a no-op for genuinely still-running jobs)
+        nudge_cutoff_us = now_us() - int(self.timeouts.result_replay_s * 1e6)
+        for state in (JobState.DISPATCHED.value, JobState.RUNNING.value):
+            wedged = await self.job_store.list_by_state_older_than(
+                state, nudge_cutoff_us, BATCH
+            )
+            for job_id in wedged:
+                if not self.engine.owns(job_id):
+                    continue
+                try:
+                    if await self.engine.nudge_inflight(job_id):
+                        n += 1
+                except Exception:
+                    logx.warn("inflight nudge failed", job_id=job_id)
         return n

@@ -1,0 +1,238 @@
+"""Fault-injection harness for statebus replication/failover testing.
+
+Reusable building blocks for chaos tests (tests/test_chaos.py, pytest
+marker ``chaos``) and operator drills:
+
+* :class:`ChaosProxy` — a TCP proxy that sits between a client and a
+  statebus endpoint and, on command, **delays**, **black-holes** (traffic
+  stalls but the connection stays open: the half-open/dead-host failure
+  mode that only liveness pings catch), **half-closes**, **severs** (RST
+  every live connection once) or **drops** (sever + refuse new
+  connections) the link — then ``restore()``s it.
+* :class:`ServerProc` — deterministic kill/restart around a real
+  ``python -m cordum_tpu.cmd.statebus`` subprocess: SIGKILL for crash
+  semantics (no GOAWAY, no flush beyond the AOF's per-record policy),
+  SIGTERM for the graceful path, and a readiness probe so restarts are
+  race-free.
+
+Everything here is asyncio-native and port-0 friendly so chaos tests can
+run inside one pytest process without fixed ports.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from . import logging as logx
+
+_MODES = ("pass", "delay", "blackhole", "drop")
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (bind-and-release)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Pipe:
+    """One direction of one proxied connection."""
+
+    def __init__(self, proxy: "ChaosProxy", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter) -> None:
+        self.proxy = proxy
+        self.reader = reader
+        self.writer = writer
+        self.task = asyncio.ensure_future(self._run())
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                # black-hole gate: bytes stall here (kernel buffers fill,
+                # the peer sees a live-but-silent connection) until restore
+                await self.proxy._gate.wait()
+                chunk = await self.reader.read(65536)
+                if not chunk:
+                    break
+                if self.proxy.delay_s > 0:
+                    await asyncio.sleep(self.proxy.delay_s)
+                # re-check after the (possibly long) read: a blackhole set
+                # while we were blocked reading must hold THIS chunk too —
+                # without it one in-flight chunk leaks through the gate,
+                # making loss-window tests racy
+                await self.proxy._gate.wait()
+                self.writer.write(chunk)
+                await self.writer.drain()
+        except asyncio.CancelledError:
+            raise
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                self.writer.close()
+            except (OSError, RuntimeError):
+                pass  # transport already torn down
+
+
+class ChaosProxy:
+    """Controllable TCP proxy in front of one ``(host, port)`` target."""
+
+    def __init__(self, target_host: str, target_port: int, *,
+                 listen_host: str = "127.0.0.1", listen_port: int = 0) -> None:
+        self.target_host = target_host
+        self.target_port = target_port
+        self.listen_host = listen_host
+        self.port = listen_port
+        self.mode = "pass"
+        self.delay_s = 0.0
+        self.connections_total = 0
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._pipes: list[_Pipe] = []
+        self._writers: list[asyncio.StreamWriter] = []
+        self._gate = asyncio.Event()
+        self._gate.set()
+
+    @property
+    def url(self) -> str:
+        return f"statebus://{self.listen_host}:{self.port}"
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_conn, self.listen_host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        logx.info("chaos proxy listening", port=self.port,
+                  target=f"{self.target_host}:{self.target_port}")
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        self.sever()
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        if self.mode == "drop":
+            writer.close()  # accept-then-reset: the endpoint looks dead
+            return
+        try:
+            up_reader, up_writer = await asyncio.open_connection(
+                self.target_host, self.target_port)
+        except (OSError, ConnectionError):
+            writer.close()
+            return
+        self.connections_total += 1
+        self._writers.extend((writer, up_writer))
+        pipes = [_Pipe(self, reader, up_writer), _Pipe(self, up_reader, writer)]
+        self._pipes.extend(pipes)
+        await asyncio.gather(*(p.task for p in pipes), return_exceptions=True)
+
+    # -- failure controls ------------------------------------------------
+    def set_delay(self, seconds: float) -> None:
+        """Add per-chunk latency in BOTH directions (keeps ordering)."""
+        self.delay_s = max(0.0, seconds)
+        self.mode = "delay" if self.delay_s > 0 else "pass"
+
+    def blackhole(self) -> None:
+        """Stop forwarding without closing anything: connections stay
+        ESTABLISHED but go silent — the failure mode a crashed host behind
+        a switch produces, detectable only by liveness pings."""
+        self.mode = "blackhole"
+        self._gate.clear()
+
+    def sever(self) -> None:
+        """RST every live proxied connection once (new ones still accepted
+        in the current mode)."""
+        for p in self._pipes:
+            p.task.cancel()
+        for w in self._writers:
+            try:
+                w.close()
+            except (OSError, RuntimeError):
+                pass  # transport already torn down
+        self._pipes.clear()
+        self._writers.clear()
+
+    def drop(self) -> None:
+        """Sever everything AND refuse (accept-then-reset) new connections
+        until ``restore()`` — the endpoint looks hard-down."""
+        self.mode = "drop"
+        self._gate.set()
+        self.sever()
+
+    def restore(self) -> None:
+        """Back to transparent pass-through for current + new connections."""
+        self.mode = "pass"
+        self.delay_s = 0.0
+        self._gate.set()
+
+
+class ServerProc:
+    """A real ``cmd.statebus`` subprocess with deterministic kill/restart.
+
+    ``env`` carries the statebus configuration (STATEBUS_PORT,
+    STATEBUS_AOF, STATEBUS_REPLICA_OF, STATEBUS_PEERS, ...).  ``start()``
+    blocks until the server answers a ``role`` probe, so tests never race
+    the bind; ``kill()`` is SIGKILL (crash semantics: no GOAWAY, no final
+    fsync); ``terminate()`` is SIGTERM (graceful path).
+    """
+
+    def __init__(self, port: int, *, env: Optional[dict] = None,
+                 cwd: str = "") -> None:
+        self.port = port
+        self.env = dict(env or {})
+        self.cwd = cwd or os.getcwd()
+        self.proc: Optional[subprocess.Popen] = None
+
+    async def start(self, *, timeout_s: float = 20.0) -> None:
+        from .replication import probe_role
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "STATEBUS_PORT": str(self.port), **self.env}
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "cordum_tpu.cmd.statebus"],
+            env=env, cwd=self.cwd)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"statebus proc exited rc={self.proc.returncode} during start")
+            if await probe_role("127.0.0.1", self.port, timeout_s=0.5) is not None:
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError(f"statebus on :{self.port} never became ready")
+
+    def kill(self) -> None:
+        """SIGKILL: the process dies mid-whatever — the crash the
+        replication layer exists to survive."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=10)
+
+    def terminate(self) -> None:
+        """SIGTERM: graceful shutdown (AOF fsync + GOAWAY broadcast)."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+    async def restart(self, *, timeout_s: float = 20.0) -> None:
+        self.kill()
+        await self.start(timeout_s=timeout_s)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None

@@ -91,6 +91,10 @@ class PoolConfig:
     # scheduler.shards: keyspace shard count for the scheduler fleet (each
     # shard binary also needs its --shard-index); 1 = unsharded
     scheduler_shards: int = 1
+    # statebus: replication defaults for the statebus fleet (cmd.statebus;
+    # env vars win): partitions, replicas-per-partition, sync_replication,
+    # heartbeat_timeout_s — docs/PROTOCOL.md §Replication
+    statebus: dict = field(default_factory=dict)
 
     def pools_for_topic(self, topic: str) -> list[Pool]:
         names = self.topics.get(topic)
@@ -131,6 +135,7 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
             pools = [pools]
         cfg.topics[topic] = list(pools or [])
     cfg.scheduler_shards = max(1, int((doc.get("scheduler") or {}).get("shards") or 1))
+    cfg.statebus = dict(doc.get("statebus") or {})
     return cfg
 
 
@@ -160,6 +165,14 @@ class Timeouts:
     # on a burst, or its owner shard being down) is safe to replay early —
     # the job lock + in-flight short-circuit make replays idempotent.
     pending_replay_s: float = 15.0
+    # how long a job may sit DISPATCHED/RUNNING before the replayer
+    # re-delivers it to its dispatch subject.  The worker side is
+    # idempotent (in-flight redeliveries dropped, completed jobs republish
+    # the cached result), so this is a result-replay request: it recovers
+    # dispatches and terminal results lost to a statebus failover window
+    # (pub/sub pushes are not replicated — docs/PROTOCOL.md §Replication)
+    # without re-running work.
+    result_replay_s: float = 20.0
     per_workflow: dict[str, float] = field(default_factory=dict)
     per_topic: dict[str, float] = field(default_factory=dict)
 
@@ -174,6 +187,7 @@ def parse_timeouts(doc: dict, *, source: str = "timeouts") -> Timeouts:
     t.running_timeout_s = float(rec.get("running_timeout_seconds", t.running_timeout_s))
     t.scan_interval_s = float(rec.get("scan_interval_seconds", t.scan_interval_s))
     t.pending_replay_s = float(rec.get("pending_replay_seconds", t.pending_replay_s))
+    t.result_replay_s = float(rec.get("result_replay_seconds", t.result_replay_s))
     t.per_workflow = {k: float(v) for k, v in (doc.get("workflows") or {}).items()}
     t.per_topic = {k: float(v) for k, v in (doc.get("topics") or {}).items()}
     return t

@@ -75,6 +75,19 @@ POOLS_SCHEMA: dict[str, Any] = {
             "properties": {"shards": {"type": "integer", "minimum": 1}},
             "additionalProperties": False,
         },
+        # statebus replication fleet defaults (cmd.statebus; env vars win —
+        # docs/PROTOCOL.md §Replication): partition count, replicas per
+        # partition, commit ack mode, and the primary-dead detection window
+        "statebus": {
+            "type": "object",
+            "properties": {
+                "partitions": {"type": "integer", "minimum": 1},
+                "replicas": _NONNEG_INT,
+                "sync_replication": {"type": "boolean"},
+                "heartbeat_timeout_s": _NONNEG,
+            },
+            "additionalProperties": False,
+        },
         # tolerated here so one file can carry pools + reconciler (dev mode)
         "reconciler": {"type": "object"},
     },
@@ -96,6 +109,7 @@ TIMEOUTS_SCHEMA: dict[str, Any] = {
                 "running_timeout_seconds": _NONNEG,
                 "scan_interval_seconds": _NONNEG,
                 "pending_replay_seconds": _NONNEG,
+                "result_replay_seconds": _NONNEG,
             },
             "additionalProperties": False,
         },

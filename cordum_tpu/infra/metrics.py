@@ -204,6 +204,46 @@ class Metrics:
             "Wire frames folded into one coalesced statebus socket write",
             buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
         )
+        # statebus replication + failover (infra/replication.py, ISSUE 8):
+        # primary-side lag per replica, stream volume, attach modes, sync-ack
+        # degradations, promotions; client-side reconnect/failover causes
+        self.statebus_repl_lag_ops = Gauge(
+            "cordum_statebus_replication_lag_ops",
+            "Committed records the labeled replica has not acked yet",
+        )
+        self.statebus_repl_lag_bytes = Gauge(
+            "cordum_statebus_replication_lag_bytes",
+            "Replication stream bytes the labeled replica has not acked yet",
+        )
+        self.statebus_repl_records = Counter(
+            "cordum_statebus_repl_records_total",
+            "Record frames shipped to replicas",
+        )
+        self.statebus_repl_syncs = Counter(
+            "cordum_statebus_repl_syncs_total",
+            "Replica attach handshakes, by catch-up mode "
+            "(incremental backlog replay vs full snapshot re-seed)",
+        )
+        self.statebus_sync_ack_timeouts = Counter(
+            "cordum_statebus_sync_ack_timeouts_total",
+            "Sync-mode commits that degraded to async because no replica "
+            "acked within the sync timeout",
+        )
+        self.statebus_promotions = Counter(
+            "cordum_statebus_promotions_total",
+            "Replica promotions to primary, by trigger "
+            "(admin | primary-dead | primary-goaway)",
+        )
+        self.statebus_reconnects = Counter(
+            "cordum_statebus_reconnects_total",
+            "Client reconnect/failover completions, by loss reason "
+            "(connection_lost | goaway | ping_timeout)",
+        )
+        self.inflight_nudges = Counter(
+            "cordum_sched_inflight_nudges_total",
+            "DISPATCHED/RUNNING jobs re-delivered to their worker to "
+            "recover dispatches/results lost to a statebus failover window",
+        )
         # scheduler tick batching (ISSUE 6): submits drained per scheduler
         # loop tick into one selection pass + grouped pipelined commits
         self.sched_tick_batch = Histogram(
@@ -273,6 +313,14 @@ class Metrics:
             self.shard_forwarded,
             self.shard_queue_depth,
             self.statebus_coalesced_batch,
+            self.statebus_repl_lag_ops,
+            self.statebus_repl_lag_bytes,
+            self.statebus_repl_records,
+            self.statebus_repl_syncs,
+            self.statebus_sync_ack_timeouts,
+            self.statebus_promotions,
+            self.statebus_reconnects,
+            self.inflight_nudges,
             self.sched_tick_batch,
             self.sched_tick_fallbacks,
             self.serving_batch_occupancy,

@@ -17,14 +17,23 @@ protocol that provides BOTH
 
 Wire format: ``[4-byte BE length][msgpack array]``.
 Requests:  ``[req_id, op, *args]`` → ``[req_id, "ok"|"err", result]``.
-Server pushes: ``[0, "msg", sid, subject, packet_bytes]``.
+Server pushes: ``[0, "msg", sid, subject, packet_bytes]`` plus the
+replication/failover pushes in :mod:`cordum_tpu.infra.replication`.
+
+Replication & failover (docs/PROTOCOL.md §Replication): a server is a
+**primary** (accepts writes, ships committed records to attached replicas)
+or a **replica** (read-only, applies the primary's stream, promotes on
+primary death or an admin ``promote`` frame).  Clients take a
+``|``-separated replica set per partition and walk it on connection loss,
+re-issuing subscriptions and retransmitting unacked in-flight frames so a
+failover never silently drops a pipelined commit.
 """
 from __future__ import annotations
 
 import asyncio
 import itertools
 import os
-import struct
+import random
 import time
 from typing import Any, Optional
 
@@ -43,16 +52,28 @@ from .bus import (
     Subscription,
     compute_msg_id,
 )
+from .frames import FrameWriter as _FrameWriter, encode_frame as _encode, read_frame as _read_frame
 from .kv import KV, MemoryKV
 from .metrics import Metrics
-
-_LEN = struct.Struct(">I")
+from .replication import (
+    ReplicaLink,
+    ReplicationState,
+    parse_endpoint,
+    parse_replica_set,
+    unpack_record,
+)
 
 
 def _read_bytes(path: str) -> bytes:
     """Sync AOF read; callers run it via asyncio.to_thread (CL003)."""
     with open(path, "rb") as f:  # cordumlint: disable=CL003 -- runs via asyncio.to_thread
         return f.read()
+
+
+def _truncate_file(path: str, size: int) -> None:
+    """Sync truncate (AOF tail recovery); runs via asyncio.to_thread."""
+    with open(path, "r+b") as f:  # cordumlint: disable=CL003 -- runs via asyncio.to_thread
+        f.truncate(size)
 
 # KV ops forwarded verbatim to the MemoryKV engine (name → is_mutation)
 _KV_OPS = {
@@ -67,79 +88,6 @@ _KV_OPS = {
 }
 
 
-def _encode(obj: Any) -> bytes:
-    b = msgpack.packb(obj, use_bin_type=True)
-    return _LEN.pack(len(b)) + b
-
-
-class _FrameWriter:
-    """Per-connection write coalescer.
-
-    ``send()`` enqueues a frame synchronously; one flusher task drains the
-    accumulated batch per wakeup.  N replies (or N pipelined requests)
-    produced in one event-loop tick cost ONE socket write + drain instead
-    of N lock/write/drain cycles — without this, pipelined commits arriving
-    from many scheduler shards interleave into tiny writes and the
-    per-frame ``drain()`` syscalls dominate the statebus hot path.
-    Batch sizes surface as ``cordum_statebus_coalesced_batch``.
-    """
-
-    __slots__ = ("_writer", "_buf", "_wake", "_task", "_metrics", "_closed")
-
-    def __init__(self, writer: asyncio.StreamWriter, metrics: Optional[Metrics] = None) -> None:
-        self._writer = writer
-        self._buf: list[bytes] = []
-        self._wake = asyncio.Event()
-        self._metrics = metrics
-        self._closed = False
-        self._task = asyncio.ensure_future(self._run())
-
-    def send(self, frame: bytes) -> None:
-        if self._closed:
-            raise ConnectionError("statebus frame writer closed")
-        self._buf.append(frame)
-        self._wake.set()
-
-    async def _run(self) -> None:
-        try:
-            while not self._closed:
-                await self._wake.wait()
-                self._wake.clear()
-                if not self._buf:
-                    continue
-                buf, self._buf = self._buf, []
-                if self._metrics is not None:
-                    self._metrics.statebus_coalesced_batch.observe(float(len(buf)))
-                self._writer.write(buf[0] if len(buf) == 1 else b"".join(buf))
-                # drain AFTER the batch: backpressure throttles the flusher
-                # (and everything queued behind it), never individual sends
-                await self._writer.drain()
-        except asyncio.CancelledError:
-            raise
-        except (ConnectionError, OSError):
-            # peer gone mid-flush: subsequent send() raises; the owning
-            # connection's read loop drives recovery/teardown
-            self._closed = True
-
-    async def close(self) -> None:
-        self._closed = True
-        self._task.cancel()
-        try:
-            await self._task
-        except (asyncio.CancelledError, ConnectionError, OSError):
-            pass
-
-
-async def _read_frame(reader: asyncio.StreamReader) -> Optional[list]:
-    try:
-        head = await reader.readexactly(4)
-        (n,) = _LEN.unpack(head)
-        body = await reader.readexactly(n)
-    except (asyncio.IncompleteReadError, ConnectionResetError):
-        return None
-    return msgpack.unpackb(body, raw=False, strict_map_key=False)
-
-
 def _plain(v: Any) -> Any:
     """msgpack-safe: sets → sorted lists."""
     if isinstance(v, set):
@@ -148,9 +96,14 @@ def _plain(v: Any) -> Any:
 
 
 class StateBusServer:
-    """The server process: KV engine + subscription routing + AOF."""
+    """The server process: KV engine + subscription routing + AOF +
+    primary/replica replication (docs/PROTOCOL.md §Replication)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 7420, *, aof_path: str = "") -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 7420, *, aof_path: str = "",
+                 replica_of: str = "", peers: tuple = (),
+                 sync_replication: bool = False, auto_promote: bool = True,
+                 heartbeat_interval_s: float = 1.0,
+                 heartbeat_timeout_s: float = 3.0) -> None:
         self.host = host
         self.port = port
         self.kv = MemoryKV()
@@ -168,6 +121,21 @@ class StateBusServer:
         # server-side observability: per-op execution latency + pipeline
         # sizes; rendered via the `metrics` wire op (cordum_statebus_op_seconds)
         self.metrics = Metrics()
+        # replication: every server tracks (epoch, offset) + a record
+        # backlog; `replica_of` starts this server as a replica of that
+        # endpoint, `peers` is the partition's replica set (used by the
+        # startup probe so a returning old primary demotes itself)
+        self.role = "replica" if replica_of else "primary"
+        self.replica_of = replica_of
+        self.peers = tuple(peers)
+        self.sync_replication = sync_replication
+        self.auto_promote = auto_promote
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.repl = ReplicationState(self)
+        self._replica_link: Optional[ReplicaLink] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self._last_peer_probe = 0.0
 
     # -- lifecycle ------------------------------------------------------
     async def start(self) -> None:
@@ -177,9 +145,38 @@ class StateBusServer:
         self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
-        logx.info("statebus listening", host=self.host, port=self.port, aof=self.aof_path or "off")
+        logx.info("statebus listening", host=self.host, port=self.port,
+                  aof=self.aof_path or "off", role=self.role,
+                  epoch=self.repl.epoch, offset=self.repl.offset)
+        if self.role == "replica":
+            await self._start_link(self.replica_of)
+        elif self.peers:
+            # returning old primary: a live peer primary with a HIGHER epoch
+            # was promoted while we were down — demote to it (exclusive
+            # promotion: no split-brain dual-accept)
+            await self._probe_peers()
+        self._hb_task = asyncio.ensure_future(self._hb_loop())
 
-    async def stop(self) -> None:
+    async def stop(self, *, graceful: bool = True) -> None:
+        if self._hb_task is not None:
+            task, self._hb_task = self._hb_task, None
+            task.cancel()
+            await logx.join_task(task, name="statebus-repl-hb")
+        if self._replica_link is not None:
+            await self._replica_link.stop()
+            self._replica_link = None
+        if graceful:
+            # GOAWAY before closing: clients fail over to the next endpoint
+            # immediately instead of waiting out call timeouts; an attached
+            # replica treats it as primary-dead and promotes NOW.  Direct
+            # transport writes (not the coalescer): the transport flushes
+            # buffered bytes before FIN on close.
+            goaway = _encode([0, "goaway"])
+            for w in list(self._writers):
+                try:
+                    w.write(goaway)
+                except (ConnectionError, OSError, RuntimeError):
+                    pass  # peer already gone
         if self._server:
             self._server.close()
         # Close client writers BEFORE wait_closed: Python 3.12's
@@ -191,9 +188,19 @@ class StateBusServer:
             await self._server.wait_closed()
             self._server = None
         if self._aof:
+            # SIGTERM-path durability: flush AND fsync before exit so a
+            # graceful shutdown never loses the tail to the page cache
             self._aof.flush()
+            os.fsync(self._aof.fileno())
             self._aof.close()
             self._aof = None
+
+    async def crash(self) -> None:
+        """Fault-injection helper (infra/chaos.py): die like a SIGKILLed
+        process — no GOAWAY, no graceful drain.  Peers see a bare EOF, and
+        any replication frames still in the write coalescers are lost
+        (exactly the async-mode loss window)."""
+        await self.stop(graceful=False)
 
     async def _replay_aof(self) -> None:
         if not os.path.exists(self.aof_path):
@@ -202,18 +209,51 @@ class StateBusServer:
         raw = await asyncio.to_thread(_read_bytes, self.aof_path)
         unpacker = msgpack.Unpacker(raw=False, strict_map_key=False)
         unpacker.feed(raw)
-        for entry in unpacker:
+        good = 0  # byte offset of the last COMPLETE, well-formed record
+        corrupt = False
+        while True:
+            try:
+                entry = unpacker.unpack()
+            except msgpack.OutOfData:
+                break  # clean EOF, or a truncated final record (crash mid-write)
+            except Exception:  # noqa: BLE001 - garbage bytes mid-stream
+                corrupt = True
+                break
+            if (not isinstance(entry, (list, tuple)) or not entry
+                    or not isinstance(entry[0], str)):
+                corrupt = True  # decoded, but not a record — trailing garbage
+                break
+            good = unpacker.tell()
             op, args = entry[0], entry[1:]
+            if op == "repl_meta":
+                self.repl.epoch = int((args[0] or {}).get("epoch", self.repl.epoch))
+                continue
+            if op == "repl_snapshot":
+                await self.kv.load_snapshot(args[1])
+                self.repl.offset = int(args[0])
+                n += 1
+                continue
             try:
                 await getattr(self.kv, op)(*args)
                 n += 1
             except Exception:
                 logx.warn("aof replay skipped bad entry", op=op)
-        logx.info("aof replayed", entries=n)
+            # logged data records count toward the replication offset even
+            # when the replay apply fails — replicas numbered them too
+            self.repl.offset += 1
+        if good < len(raw):
+            # crash mid-write: recover to the last complete record instead
+            # of raising, and truncate so appends continue from a clean tail
+            logx.warn("aof tail truncated/corrupt; recovering",
+                      path=self.aof_path, dropped_bytes=len(raw) - good,
+                      garbage=corrupt)
+            await asyncio.to_thread(_truncate_file, self.aof_path, good)
+        logx.info("aof replayed", entries=n, offset=self.repl.offset,
+                  epoch=self.repl.epoch)
 
-    def _log_aof(self, op: str, args: tuple) -> None:
+    def _append_aof(self, rec: bytes) -> None:
         if self._aof is not None:
-            self._aof.write(msgpack.packb([op, *args], use_bin_type=True))
+            self._aof.write(rec)
             # flush before the op is acked: process-crash durability (an
             # fsync interval below bounds power-loss exposure)
             self._aof.flush()
@@ -221,6 +261,181 @@ class StateBusServer:
             if now - self._last_fsync > 0.2:
                 os.fsync(self._aof.fileno())
                 self._last_fsync = now
+
+    def _commit_record(self, op: str, args: tuple) -> int:
+        """Durably log one committed mutation and ship it to replicas.
+
+        One msgpack record serves both the AOF and the replication stream;
+        returns the record's replication offset (sync-mode commits wait on
+        it before acking the client)."""
+        rec = msgpack.packb([op, *args], use_bin_type=True)
+        self._append_aof(rec)
+        return self.repl.advance(rec)
+
+    # -- replication role management ------------------------------------
+    def _persist_epoch(self) -> None:
+        if self._aof is not None:
+            self._aof.write(msgpack.packb(
+                ["repl_meta", {"epoch": self.repl.epoch}], use_bin_type=True))
+            self._aof.flush()
+            os.fsync(self._aof.fileno())
+
+    async def _start_link(self, primary_url: str) -> None:
+        host, port = parse_endpoint(primary_url)
+        self._replica_link = ReplicaLink(
+            self, host, port, replica_id=f"{self.host}:{self.port}",
+            auto_promote=self.auto_promote,
+            heartbeat_timeout_s=self.heartbeat_timeout_s)
+        await self._replica_link.start()
+
+    async def _probe_peers(self) -> None:
+        from .replication import probe_role
+
+        for ep in self.peers:
+            host, port = parse_endpoint(ep)
+            if (host, port) == (self.host, self.port):
+                continue
+            doc = await probe_role(host, port, timeout_s=1.0)
+            if (doc and doc.get("role") == "primary"
+                    and int(doc.get("epoch", 0)) > self.repl.epoch):
+                logx.warn("peer primary holds a higher epoch; demoting self",
+                          peer=f"{host}:{port}", peer_epoch=doc.get("epoch"),
+                          epoch=self.repl.epoch)
+                await self.demote(f"statebus://{host}:{port}", reason="peer-epoch")
+                return
+
+    async def promote(self, *, reason: str = "admin") -> dict:
+        """Replica → primary (admin ``promote`` frame, or automatic takeover
+        on primary-dead).  Bumps + persists the epoch so promotion is
+        exclusive: a returning old primary sees the higher epoch and
+        demotes itself."""
+        if self.role != "primary":
+            link, self._replica_link = self._replica_link, None
+            self.role = "primary"
+            self.replica_of = ""
+            self.repl.epoch += 1
+            self._persist_epoch()
+            self.metrics.statebus_promotions.inc(reason=reason)
+            logx.info("statebus PROMOTED to primary", host=self.host,
+                      port=self.port, reason=reason, epoch=self.repl.epoch,
+                      offset=self.repl.offset)
+            if link is not None:
+                await link.stop()
+        return {"role": self.role, "epoch": self.repl.epoch,
+                "offset": self.repl.offset}
+
+    async def demote(self, primary_url: str, *, reason: str = "admin") -> dict:
+        """Primary → replica of ``primary_url`` (startup peer probe, or an
+        admin demotion).  Ordinary clients get a GOAWAY so they re-walk the
+        replica set to the real primary."""
+        if self._replica_link is not None:
+            await self._replica_link.stop()
+            self._replica_link = None
+        self.role = "replica"
+        self.replica_of = primary_url
+        self.repl.fail_waiters()
+        for w in list(self.repl.sessions):
+            self.repl.detach(w)
+        goaway = _encode([0, "goaway"])
+        for w in list(self._writers):
+            try:
+                w.write(goaway)
+            except (ConnectionError, OSError, RuntimeError):
+                pass  # peer already gone
+        await self._start_link(primary_url)
+        logx.info("statebus demoted to replica", primary=primary_url,
+                  reason=reason, epoch=self.repl.epoch)
+        return {"role": self.role, "epoch": self.repl.epoch,
+                "offset": self.repl.offset}
+
+    async def adopt_epoch(self, epoch: int) -> None:
+        """Replica adopting its primary's epoch at incremental handshake."""
+        if epoch != self.repl.epoch:
+            self.repl.epoch = int(epoch)
+            self._persist_epoch()
+
+    async def apply_replicated(self, rec: bytes, offset: int) -> None:
+        """Apply one primary record on a replica (ReplicaLink pump)."""
+        if self.role != "replica" or offset <= self.repl.offset:
+            return  # stale link after promotion, or an overlap duplicate
+        entry = unpack_record(rec)
+        op, args = entry[0], entry[1:]
+        try:
+            await getattr(self.kv, op)(*args)
+        except Exception:
+            logx.warn("replicated record failed to apply", op=op)
+        self._append_aof(rec)
+        self.repl.offset = int(offset)
+        self.repl.bytes_total += len(rec)
+        # keep our own backlog current: after promotion, OTHER replicas
+        # (including the returning old primary) catch up incrementally
+        self.repl.backlog.append((int(offset), rec, self.repl.bytes_total))
+
+    async def load_replicated_snapshot(self, epoch: int, offset: int, blob: bytes) -> None:
+        """Re-seed a replica whose history diverged / fell past the backlog."""
+        await self.kv.load_snapshot(blob)
+        self.repl.epoch = int(epoch)
+        self.repl.offset = int(offset)
+        self.repl.bytes_total = 0
+        self.repl.backlog.clear()
+        if self._aof is not None:
+            await asyncio.to_thread(self._rewrite_aof_snapshot, int(offset), blob)
+        logx.info("replica re-seeded from snapshot", epoch=epoch, offset=offset)
+
+    def _rewrite_aof_snapshot(self, offset: int, blob: bytes) -> None:
+        """Sync AOF rewrite after a snapshot load (via asyncio.to_thread):
+        the old log described a different history and must not replay."""
+        self._aof.truncate(0)
+        self._aof.write(msgpack.packb(
+            ["repl_meta", {"epoch": self.repl.epoch}], use_bin_type=True))
+        self._aof.write(msgpack.packb(
+            ["repl_snapshot", offset, blob], use_bin_type=True))
+        self._aof.flush()
+        os.fsync(self._aof.fileno())
+
+    async def _hb_loop(self) -> None:
+        """Primary liveness beacon: replicas promote when it goes quiet.
+
+        The same tick also guards the OTHER split-brain direction: a primary
+        whose replicas all detached may have been spuriously failed over (a
+        GC pause or event-loop stall reads as primary-dead to the replica,
+        which promotes).  With a configured peer set, such a primary probes
+        its peers every ``heartbeat_timeout_s`` and demotes itself to a live
+        higher-epoch primary — the runtime extension of the startup probe,
+        so exclusive promotion holds without waiting for a restart."""
+        while True:
+            await asyncio.sleep(self.heartbeat_interval_s)
+            if self.role != "primary":
+                continue
+            if self.repl.sessions:
+                frame = _encode([0, "repl_hb", self.repl.epoch, self.repl.offset])
+                for w, sess in list(self.repl.sessions.items()):
+                    try:
+                        sess.fw.send(frame)
+                    except ConnectionError:
+                        self.repl.detach(w)
+            elif self.peers:
+                now = time.monotonic()
+                if now - self._last_peer_probe >= self.heartbeat_timeout_s:
+                    self._last_peer_probe = now
+                    await self._probe_peers()
+
+    def _role_doc(self) -> dict:
+        doc = {
+            "role": self.role,
+            "epoch": self.repl.epoch,
+            "offset": self.repl.offset,
+            "sync": self.sync_replication,
+            "primary": self.replica_of,
+            "endpoint": f"{self.host}:{self.port}",
+            "replicas": self.repl.status()["replicas"],
+        }
+        link = self._replica_link
+        if link is not None:
+            doc["link_connected"] = link.connected.is_set()
+            doc["primary_offset"] = link.primary_offset
+            doc["lag_ops"] = max(0, link.primary_offset - self.repl.offset)
+        return doc
 
     # -- connection handling -------------------------------------------
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -240,6 +455,11 @@ class StateBusServer:
             self._writers.discard(writer)
             self._fws.pop(writer, None)
             await fw.close()
+            self.repl.detach(writer)
+            if not self.repl.sessions:
+                # no replica left to ack: release sync-mode commits now
+                # instead of making each wait out its timeout
+                self.repl.fail_waiters()
             dead = [sid for sid, (w, _, _) in self._subs.items() if w is writer]
             for sid in dead:
                 del self._subs[sid]
@@ -257,29 +477,56 @@ class StateBusServer:
     async def _dispatch(self, frame: list, writer: asyncio.StreamWriter) -> None:
         req_id, op, *args = frame
         try:
+            if req_id == 0:
+                # client→server notification (no reply): replica acks
+                if op == "repl_ack" and args:
+                    self.repl.on_ack(writer, int(args[0]))
+                return
             if op in _KV_OPS:
+                if _KV_OPS[op] and self.role != "primary":
+                    await self._send(writer, [
+                        req_id, "err",
+                        f"READONLY replica of {self.replica_of or 'unknown'}"])
+                    return
                 t0 = time.perf_counter()
                 result = await getattr(self.kv, op)(*args)
-                if _KV_OPS[op]:
-                    self._log_aof(op, tuple(args))
+                offset = self._commit_record(op, tuple(args)) if _KV_OPS[op] else 0
                 self.metrics.statebus_op_seconds.observe(
                     time.perf_counter() - t0, op=op
                 )
+                if offset and self.sync_replication and self.repl.sessions:
+                    await self.repl.wait_synced(offset)
                 await self._send(writer, [req_id, "ok", _plain(result)])
             elif op == "pipe":
                 # one wire frame = one atomic multi-op batch (the whole point
-                # of the pipeline layer: N mutations, ONE round trip)
+                # of the pipeline layer: N mutations, ONE round trip) — and
+                # therefore the atomic REPLICATION unit: the batch ships to
+                # replicas as a single pipe_execute record
+                if self.role != "primary":
+                    await self._send(writer, [
+                        req_id, "err",
+                        f"READONLY replica of {self.replica_of or 'unknown'}"])
+                    return
                 watches, ops = args
                 t0 = time.perf_counter()
                 ok, versions = await self.kv.pipe_execute(watches, ops)
-                self._log_aof("pipe_execute", (watches, ops))
+                offset = self._commit_record("pipe_execute", (watches, ops))
                 self.metrics.statebus_op_seconds.observe(
                     time.perf_counter() - t0, op="pipe"
                 )
                 self.metrics.kv_pipeline_size.observe(float(len(ops)))
+                if self.sync_replication and self.repl.sessions:
+                    await self.repl.wait_synced(offset)
                 await self._send(writer, [req_id, "ok", [ok, versions]])
             elif op == "metrics":
                 await self._send(writer, [req_id, "ok", self.metrics.render()])
+            elif op == "role":
+                await self._send(writer, [req_id, "ok", self._role_doc()])
+            elif op == "promote":
+                await self._send(writer, [req_id, "ok",
+                                          await self.promote(reason="admin")])
+            elif op == "repl_sync":
+                await self._handle_repl_sync(req_id, writer, *args)
             elif op == "sub":
                 pattern, queue = args
                 sid = next(self._sid)
@@ -338,26 +585,87 @@ class StateBusServer:
             except Exception as e:  # noqa: BLE001 - one dead peer must not stop fanout
                 logx.debug("dropping subscriber mid-fanout", sid=sid, err=str(e))
 
+    async def _handle_repl_sync(self, req_id: int, writer: asyncio.StreamWriter,
+                                replica_id: str, epoch: int, offset: int) -> None:
+        """Replica attach handshake: incremental catch-up from the record
+        backlog when the replica shares our history (same epoch, offset
+        within the backlog window), full snapshot re-seed otherwise."""
+        if self.role != "primary":
+            await self._send(writer, [
+                req_id, "err", f"not primary (replica of {self.replica_of})"])
+            return
+        fw = self._fws.get(writer)
+        if fw is None:
+            return
+        epoch, offset = int(epoch), int(offset)
+        if (epoch == self.repl.epoch and offset <= self.repl.offset
+                and self.repl.covers(offset)):
+            self.repl.attach(writer, replica_id, fw, offset)
+            await self._send(writer, [
+                req_id, "ok", ["incremental", self.repl.epoch, self.repl.offset]])
+            for rec_frame in self.repl.records_after(offset):
+                fw.send(rec_frame)
+            self.metrics.statebus_repl_syncs.inc(mode="incremental")
+            mode = "incremental"
+        else:
+            # snapshot + offset are captured in one event-loop tick (MemoryKV
+            # never holds its lock across an await), so no commit can land
+            # between the blob and the offset it claims to represent
+            blob = await self.kv.snapshot()
+            snap_offset = self.repl.offset
+            # acked starts at 0: the replica only counts as caught up (for
+            # sync-mode waits) once it confirms the snapshot load itself
+            self.repl.attach(writer, replica_id, fw, 0)
+            await self._send(writer, [
+                req_id, "ok", ["snapshot", self.repl.epoch, snap_offset]])
+            fw.send(_encode([0, "repl_snap", self.repl.epoch, snap_offset, blob]))
+            self.metrics.statebus_repl_syncs.inc(mode="snapshot")
+            mode = "snapshot"
+        logx.info("replica attached", replica=replica_id, mode=mode,
+                  replica_offset=offset, primary_offset=self.repl.offset)
+
+
+class _NotPrimary(ConnectionError):
+    """Dialed endpoint is a replica; the failover walk tries the next one."""
+
+
+#: ops never retransmitted across a reconnect: sub/unsub would duplicate or
+#: kill the wrong sid (the registry re-issues subs itself), ping/role are
+#: liveness probes whose answer is stale by definition after a failover
+_NO_RETRANSMIT = frozenset(("sub", "unsub", "ping", "role"))
+
 
 class StateBusConn:
-    """Shared TCP connection: request/response + push routing.
+    """Shared TCP connection: request/response + push routing + failover.
 
-    Auto-reconnects with exponential backoff when the connection drops
-    (reference NATS behavior: infinite reconnect, ``nats.go:59``).  In-flight
-    calls fail with :class:`ConnectionError`; subsequent calls wait for the
-    reconnect (bounded by their timeout) and succeed; subscriptions are
-    re-issued server-side on every reconnect, so one statebus blip no longer
-    wedges a service until restart.
+    Auto-reconnects with jittered exponential backoff when the connection
+    drops (reference NATS behavior: infinite reconnect, ``nats.go:59``),
+    walking the partition's ``|``-separated replica set until it finds the
+    current PRIMARY (each dial is role-checked when the set has more than
+    one endpoint).  Unacked in-flight request frames are retransmitted on
+    the fresh connection — a pipelined commit caught mid-failover is
+    re-applied (version watches make the retry conflict, not double-apply,
+    when the old primary had committed and replicated it) instead of being
+    silently dropped.  Subscriptions are re-issued server-side on every
+    reconnect; a server GOAWAY (graceful shutdown/demotion) fails over
+    immediately, and an optional ping loop turns black-holed connections
+    (host died without FIN/RST) into failovers too.
     """
 
     def __init__(self, host: str, port: int, *, reconnect: bool = True,
-                 max_backoff_s: float = 2.0) -> None:
-        self.host = host
-        self.port = port
+                 max_backoff_s: float = 2.0,
+                 endpoints: Optional[list[tuple[str, int]]] = None,
+                 ping_interval_s: float = 0.0,
+                 verify_primary: Optional[bool] = None) -> None:
+        self.endpoints = [tuple(e) for e in (endpoints or [(host, port)])]
+        self._ep_i = 0
+        self.host, self.port = self.endpoints[0]
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._req_id = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
+        # unacked in-flight frames, replayed after failover: req_id → (op, frame)
+        self._inflight: dict[int, tuple[str, bytes]] = {}
         self._handlers: dict[int, Any] = {}  # server sid → async handler(subject, bytes)
         self._reader_task: Optional[asyncio.Task] = None
         self._fw: Optional[_FrameWriter] = None
@@ -371,13 +679,26 @@ class StateBusConn:
         self._local_sid = itertools.count(1)
         self._subs: dict[int, dict] = {}
         self.reconnect_count = 0
+        # bound via StateBusKV.bind_metrics: cordum_statebus_reconnects_total
+        self.metrics: Any = None
+        self._loss_reason = "connection_lost"
+        # a single-endpoint conn skips the role round trip (standalone
+        # servers are always primary); replica sets must verify, or a write
+        # could land on a READONLY replica mid-promotion
+        self._verify_primary = (len(self.endpoints) > 1
+                                if verify_primary is None else verify_primary)
+        self._ping_interval_s = ping_interval_s
+        self._ping_task: Optional[asyncio.Task] = None
         # connection epoch: bumped on every successful dial; server sids are
         # only meaningful within the epoch that created them (a restarted
         # server reuses low sids, so a stale unsub could kill the wrong sub)
         self._epoch = 0
 
     async def connect(self) -> None:
-        await self._dial()
+        await self._connect_cycle()
+        self._connected.set()
+        if self._ping_interval_s > 0:
+            self._ping_task = asyncio.ensure_future(self._ping_loop())
 
     async def _dial(self) -> None:
         if self._reader_task is not None and not self._reader_task.done():
@@ -386,17 +707,42 @@ class StateBusConn:
             self._reader_task.cancel()
         if self._fw is not None:
             await self._fw.close()
+        if self._writer is not None:
+            self._writer.close()
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
         self._fw = _FrameWriter(self._writer)
         self._epoch += 1
         self._reader_task = asyncio.ensure_future(self._read_loop())
-        self._connected.set()
+
+    async def _connect_cycle(self) -> None:
+        """One walk over the replica set; connects to the first PRIMARY.
+
+        Raises the last failure when no endpoint works this cycle (a
+        not-yet-promoted replica counts as a failure — the reconnect loop
+        keeps cycling until promotion flips one to primary)."""
+        last: Optional[Exception] = None
+        for _ in range(len(self.endpoints)):
+            self.host, self.port = self.endpoints[self._ep_i]
+            try:
+                await self._dial()
+                if self._verify_primary:
+                    doc = await self._call_now("role", timeout_s=3.0)
+                    if not isinstance(doc, dict) or doc.get("role") != "primary":
+                        raise _NotPrimary(
+                            f"{self.host}:{self.port} is not primary")
+                return
+            except (OSError, ConnectionError) as e:
+                last = e
+                self._ep_i = (self._ep_i + 1) % len(self.endpoints)
+        raise last if last is not None else ConnectionError("no statebus endpoints")
 
     async def close(self) -> None:
         self._closed = True
         self._connected.set()  # release any call() waiting on reconnect
         if self._reconnect_task:
             self._reconnect_task.cancel()
+        if self._ping_task:
+            self._ping_task.cancel()
         if self._reader_task:
             self._reader_task.cancel()
         if self._fw is not None:
@@ -408,6 +754,7 @@ class StateBusConn:
             if not fut.done():
                 fut.set_result(None)
         self._pending.clear()
+        self._inflight.clear()
 
     async def _read_loop(self) -> None:
         try:
@@ -415,14 +762,22 @@ class StateBusConn:
                 frame = await _read_frame(self._reader)
                 if frame is None:
                     break
-                if frame[0] == 0 and frame[1] == "msg":
-                    _, _, sid, subject, packet_bytes = frame
-                    handler = self._handlers.get(sid)
-                    if handler is not None:
-                        asyncio.ensure_future(handler(subject, packet_bytes))
-                    continue
+                if frame[0] == 0:
+                    kind = frame[1] if len(frame) > 1 else ""
+                    if kind == "msg":
+                        _, _, sid, subject, packet_bytes = frame
+                        handler = self._handlers.get(sid)
+                        if handler is not None:
+                            asyncio.ensure_future(handler(subject, packet_bytes))
+                        continue
+                    if kind == "goaway":
+                        # graceful server shutdown / demotion: fail over NOW
+                        self._loss_reason = "goaway"
+                        break
+                    continue  # unknown push (repl traffic etc.) — not ours
                 req_id, status, result = frame
                 fut = self._pending.pop(req_id, None)
+                self._inflight.pop(req_id, None)
                 if fut is not None and not fut.done():
                     if status == "ok":
                         fut.set_result(result)
@@ -435,36 +790,57 @@ class StateBusConn:
             # error) must fall into the recovery tail below — otherwise the
             # client wedges with _connected still set and no reconnect
             logx.warn("statebus read loop failed; treating as connection loss")
-        # connection lost: fail in-flight calls, then (unless deliberately
-        # closed) start the reconnect loop
+        # connection lost: keep in-flight calls parked for retransmission
+        # (each still bounded by its own call timeout); only non-replayable
+        # ops (sub/unsub/ping/role) fail immediately.  Then — unless
+        # deliberately closed — start the failover walk.
         self._connected.clear()
-        for fut in self._pending.values():
-            if not fut.done():
-                fut.set_exception(ConnectionError("statebus connection lost"))
-        self._pending.clear()
+        for req_id, (op, _) in list(self._inflight.items()):
+            if op in _NO_RETRANSMIT:
+                fut = self._pending.pop(req_id, None)
+                self._inflight.pop(req_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(ConnectionError("statebus connection lost"))
         if not self._closed and self._reconnect:
             t = self._reconnect_task
             if t is None or t.done():  # never two concurrent reconnect loops
                 logx.warn("statebus connection lost; reconnecting",
-                          host=self.host, port=self.port)
+                          host=self.host, port=self.port,
+                          reason=self._loss_reason)
                 self._reconnect_task = asyncio.ensure_future(self._reconnect_loop())
+        elif not self._closed:
+            # no reconnect: surface the loss to in-flight callers directly
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("statebus connection lost"))
+            self._pending.clear()
+            self._inflight.clear()
 
     async def _reconnect_loop(self) -> None:
         backoff = 0.05
+        reason = self._loss_reason
         while not self._closed:
             try:
-                await self._dial()
+                await self._connect_cycle()
                 await self._resubscribe()
+                self._retransmit_inflight()
                 self.reconnect_count += 1
+                if self.metrics is not None:
+                    self.metrics.statebus_reconnects.inc(reason=reason)
+                self._loss_reason = "connection_lost"
                 logx.info("statebus reconnected", host=self.host, port=self.port,
-                          subs=len(self._subs))
+                          subs=len(self._subs), inflight=len(self._inflight),
+                          reason=reason)
+                self._connected.set()
                 return
             except (OSError, ConnectionError):
-                # dial refused OR the fresh connection died mid-resubscribe —
-                # either way this same loop retries (the dead reader task is
-                # cancelled by the next _dial, so no second loop spawns)
+                # every endpoint refused / not primary / died mid-resubscribe
+                # — this same loop retries the whole walk (the dead reader
+                # task is cancelled by the next _dial, so no second loop
+                # spawns).  Jittered exponential backoff: a fleet of clients
+                # failing over together must not dial in lockstep.
                 self._connected.clear()
-                await asyncio.sleep(backoff)
+                await asyncio.sleep(backoff * (0.5 + random.random() / 2))
                 backoff = min(backoff * 2, self._max_backoff_s)
 
     async def _resubscribe(self) -> None:
@@ -478,6 +854,34 @@ class StateBusConn:
             entry["sid"] = sid
             entry["epoch"] = self._epoch
             self._handlers[sid] = entry["handler"]
+
+    def _retransmit_inflight(self) -> None:
+        """Replay unacked request frames on the fresh connection, in
+        original send order.  Version-watched commits that DID apply before
+        the failover conflict instead of double-applying; callers' conflict
+        paths already handle that (at-least-once, like bus redelivery)."""
+        for req_id in sorted(self._inflight):
+            _, frame = self._inflight[req_id]
+            self._fw.send(frame)
+
+    async def _ping_loop(self) -> None:
+        """Liveness probe: a black-holed connection (peer died without
+        FIN/RST, or a proxy swallowing traffic) never EOFs the reader —
+        a failed ping forces the transport closed so the normal recovery
+        tail runs the failover walk."""
+        while not self._closed:
+            await asyncio.sleep(self._ping_interval_s)
+            if self._closed or not self._connected.is_set():
+                continue
+            try:
+                await self._call_now("ping",
+                                     timeout_s=max(1.0, self._ping_interval_s))
+            except ConnectionError:
+                if self._connected.is_set() and self._writer is not None:
+                    self._loss_reason = "ping_timeout"
+                    logx.warn("statebus ping timed out; forcing failover",
+                              host=self.host, port=self.port)
+                    self._writer.close()
 
     # -- subscriptions (registry survives reconnects) -------------------
     async def subscribe(self, pattern: str, queue: str, handler) -> int:
@@ -535,21 +939,28 @@ class StateBusConn:
     async def _call_now(self, op: str, *args: Any, timeout_s: float = 15.0) -> Any:
         req_id = next(self._req_id)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        frame = _encode([req_id, op, *args])
         self._pending[req_id] = fut
+        self._inflight[req_id] = (op, frame)
         try:
             # coalesced write: the frame enqueues synchronously and rides the
             # connection's next batched flush — concurrent in-flight calls
             # (engine submit_concurrency) share one socket write per tick
-            self._fw.send(_encode([req_id, op, *args]))
+            self._fw.send(frame)
         except (AttributeError, ConnectionError, OSError) as e:
-            self._pending.pop(req_id, None)
-            raise ConnectionError(f"statebus call {op!r} failed: {e}")
+            if (op in _NO_RETRANSMIT or self._closed or not self._reconnect):
+                self._pending.pop(req_id, None)
+                self._inflight.pop(req_id, None)
+                raise ConnectionError(f"statebus call {op!r} failed: {e}")
+            # connection mid-teardown: leave the frame parked — the failover
+            # walk retransmits it, and the caller's timeout still bounds it
         try:
             # bounded wait: a half-open TCP connection (host died without
             # FIN/RST) must surface as an error, not wedge the service
             return await asyncio.wait_for(fut, timeout_s)
         except asyncio.TimeoutError:
             self._pending.pop(req_id, None)
+            self._inflight.pop(req_id, None)
             raise ConnectionError(f"statebus call {op!r} timed out after {timeout_s}s")
 
 
@@ -562,6 +973,11 @@ class StateBusKV(KV):
 
     def __init__(self, conn: StateBusConn) -> None:
         self.conn = conn
+
+    def bind_metrics(self, metrics: Any) -> None:
+        super().bind_metrics(metrics)
+        # the connection emits cordum_statebus_reconnects_total{reason}
+        self.conn.metrics = metrics
 
     async def close(self) -> None:
         await self.conn.close()
@@ -672,12 +1088,27 @@ class StateBusBus(Bus):
             return False
 
 
-async def connect(url: str = "") -> tuple[StateBusKV, StateBusBus, StateBusConn]:
-    """Parse ``statebus://host:port`` (env CORDUM_STATEBUS_URL) and connect."""
+#: liveness-ping cadence for replica-set connections (black-hole detection);
+#: single-endpoint connections skip the ping loop entirely
+FAILOVER_PING_INTERVAL_S = 5.0
+
+
+async def connect(url: str = "", *,
+                  ping_interval_s: Optional[float] = None,
+                  ) -> tuple[StateBusKV, StateBusBus, StateBusConn]:
+    """Parse one partition's endpoint(s) (env CORDUM_STATEBUS_URL) and connect.
+
+    ``url`` may be a single ``statebus://host:port`` or a ``|``-separated
+    replica set (``statebus://h:7420|statebus://h:7520``, primary listed
+    first); the connection walks the set on every connection loss until it
+    finds the current primary.
+    """
     url = url or os.environ.get("CORDUM_STATEBUS_URL", "statebus://127.0.0.1:7420")
-    hostport = url.split("://", 1)[-1]
-    host, _, port = hostport.partition(":")
-    conn = StateBusConn(host or "127.0.0.1", int(port or 7420))
+    endpoints = parse_replica_set(url)
+    if ping_interval_s is None:
+        ping_interval_s = FAILOVER_PING_INTERVAL_S if len(endpoints) > 1 else 0.0
+    conn = StateBusConn(*endpoints[0], endpoints=endpoints,
+                        ping_interval_s=ping_interval_s)
     await conn.connect()
     return StateBusKV(conn), StateBusBus(conn), conn
 
@@ -989,9 +1420,12 @@ class ConnGroup:
 async def connect_partitioned(url: str = "") -> tuple[KV, Bus, ConnGroup]:
     """Connect to one or more statebus partitions.
 
-    ``url`` is a comma-separated list of ``statebus://host:port`` endpoints
-    (env ``CORDUM_STATEBUS_URL``); a single endpoint degrades to the plain
-    unpartitioned client, so every service binary can use this entry point.
+    ``url`` is a comma-separated list of partitions (env
+    ``CORDUM_STATEBUS_URL``); each partition is a single
+    ``statebus://host:port`` endpoint or a ``|``-separated replica set that
+    the connection fails over across (docs/PROTOCOL.md §Replication).  A
+    single partition degrades to the plain unpartitioned client, so every
+    service binary can use this entry point.
     """
     url = url or os.environ.get("CORDUM_STATEBUS_URL", "statebus://127.0.0.1:7420")
     endpoints = [u.strip() for u in url.split(",") if u.strip()]

@@ -292,7 +292,8 @@ def test_floor_checker_passes_healthy_doc():
            "statebus_pipeline_speedup": 1.9,
            "sharded_jobs_per_sec": 300.0, "sharded_single_jobs_per_sec": 320.0,
            "serving_speedup": 4.5, "serving_affinity_hit_rate": 1.0,
-           "decode_tokens_per_sec": 2900.0}
+           "decode_tokens_per_sec": 2900.0,
+           "statebus_replication_overhead_pct": 8.0}
     floors = json.loads((REPO / "bench_floor.json").read_text())
     assert mod.check(doc, floors) == []
 
@@ -307,7 +308,8 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "statebus_pipeline_speedup": 1.9,
            "sharded_jobs_per_sec": 300.0, "sharded_single_jobs_per_sec": 320.0,
            "serving_speedup": 4.5, "serving_affinity_hit_rate": 1.0,
-           "decode_tokens_per_sec": 2900.0}
+           "decode_tokens_per_sec": 2900.0,
+           "statebus_replication_overhead_pct": 8.0}
     violations = mod.check(doc, floors)
     assert violations and "value" in violations[0]
     # ceilings guard the other direction (round-trip budget regression)
