@@ -36,11 +36,13 @@ import socket
 import subprocess
 import sys
 import time
+from typing import Optional
 
 N_JOBS = int(os.environ.get("BENCH_JOBS", "3000"))
 PACED_JOBS = int(os.environ.get("BENCH_PACED_JOBS", "1500"))
 PACED_RATE = float(os.environ.get("BENCH_PACED_RATE", "1000"))  # jobs/s offered
 STATEBUS_JOBS = int(os.environ.get("BENCH_STATEBUS_JOBS", "600"))
+TELEMETRY_JOBS = int(os.environ.get("BENCH_TELEMETRY_JOBS", "2000"))
 SHARDED_JOBS = int(os.environ.get("BENCH_SHARDED_JOBS", "2000"))
 SHARDS = int(os.environ.get("BENCH_SHARDS", "4"))
 SB_PARTITIONS = int(os.environ.get("BENCH_STATEBUS_PARTITIONS", "2"))
@@ -88,13 +90,39 @@ def _make_stack():
     return kv, bus, js, eng
 
 
-async def bench_scheduler() -> dict:
-    """Burst throughput: N_JOBS submitted as fast as possible."""
+async def bench_scheduler(telemetry: bool = False,
+                          n_jobs: Optional[int] = None) -> dict:
+    """Burst throughput: N_JOBS submitted as fast as possible.
+
+    ``telemetry=True`` attaches the full fleet telemetry plane (ISSUE 9) to
+    the same loopback stack — a TelemetryExporter on the scheduler registry
+    at an aggressive 0.25 s cadence plus the gateway-role FleetAggregator +
+    SLOTracker — so interleaved plain/instrumented pairs measure the export
+    overhead, and the post-run fleet snapshot is checked for correctness
+    (merged counter == the engine registry, SLO burn rate present)."""
     from cordum_tpu.protocol import subjects as subj
     from cordum_tpu.protocol.types import BusPacket, JobRequest, JobResult
 
     kv, bus, js, eng = _make_stack()
     await eng.start()
+
+    agg = tracker = exporter = None
+    if telemetry:
+        from cordum_tpu.infra.metrics import Metrics
+        from cordum_tpu.obs import FleetAggregator, SLOTracker, TelemetryExporter
+
+        agg = FleetAggregator(bus, metrics=Metrics(), fine_step_s=0.5)
+        await agg.start()
+        tracker = SLOTracker.from_config(
+            {"batch": {"job_class": "BATCH", "latency_ms": 1000,
+                       "latency_target": 0.95}})
+        exporter = TelemetryExporter(
+            "scheduler", bus, eng.metrics, instance_id="bench-sched-0",
+            interval_s=0.25,
+            health_fn=lambda: {"role": "scheduler",
+                               "jobs_scheduled": eng.metrics.jobs_dispatched.total()},
+        )
+        await exporter.start()
 
     async def worker_handler(subject, pkt):
         req = pkt.job_request
@@ -108,15 +136,16 @@ async def bench_scheduler() -> dict:
 
     await bus.subscribe(subj.direct_subject("bench-w"), worker_handler, queue="w")
 
+    jobs_target = N_JOBS if n_jobs is None else n_jobs
     t0 = time.perf_counter()
-    for i in range(N_JOBS):
+    for i in range(jobs_target):
         req = JobRequest(job_id=f"bench-{i}", topic="job.bench", tenant_id="default")
         await bus.publish(subj.SUBMIT, BusPacket.wrap(req, sender_id="bench"))
     await bus.drain()
     deadline = time.perf_counter() + 120
     while time.perf_counter() < deadline:
         await bus.drain()
-        if eng.metrics.jobs_completed.value(status="SUCCEEDED") >= N_JOBS:
+        if eng.metrics.jobs_completed.value(status="SUCCEEDED") >= jobs_target:
             break
         await asyncio.sleep(0.01)
     dt = time.perf_counter() - t0
@@ -124,13 +153,36 @@ async def bench_scheduler() -> dict:
     # per-job KV chatter on the full submit→result loop (the engine binds
     # cordum_kv_roundtrips_total to its store; ISSUE 4 acceptance metric)
     roundtrips = eng.metrics.kv_roundtrips.total()
-    await eng.stop()
-    await bus.close()
-    return {
+    out = {
         "jobs": int(n), "seconds": dt,
         "jobs_per_sec": n / dt if dt > 0 else 0.0,
         "kv_roundtrips_per_job": roundtrips / n if n else 0.0,
     }
+    if telemetry:
+        # flush one final snapshot, then verify the fleet view end to end
+        await exporter.publish_once()
+        await bus.drain()
+        agg.sample()
+        doc = agg.fleet_doc(tracker)
+        merged = doc["fleet"]["jobs_dispatched_total"]
+        engine_total = eng.metrics.jobs_dispatched.total()
+        slo = (doc.get("slo") or [{}])[0]
+        w5 = (slo.get("windows") or {}).get("5m") or {}
+        out["fleet_snapshot_ok"] = float(
+            doc["healthy_services"] >= 1
+            and merged == engine_total
+            and engine_total > 0
+            and isinstance(w5.get("burn_rate"), (int, float))
+            and w5.get("total", 0) > 0
+        )
+        out["fleet_services"] = doc["healthy_services"]
+        out["slo_burn_rate_5m"] = w5.get("burn_rate", -1.0)
+        out["slo_state"] = slo.get("state", "")
+        await exporter.stop()
+        await agg.stop()
+    await eng.stop()
+    await bus.close()
+    return out
 
 
 async def bench_latency() -> dict:
@@ -372,6 +424,43 @@ async def bench_statebus(pipelined: bool, n_jobs: int, *,
                 replica_child.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 replica_child.kill()
+
+
+def bench_telemetry(pairs: int = 5) -> dict:
+    """Fleet telemetry export cost + snapshot correctness (ISSUE 9).
+
+    Interleaved (plain, instrumented) scheduler-burst pairs at the FULL
+    telemetry job count (smoke-sized runs finish in ~0.1 s, putting startup
+    noise in the same decade as the effect — the replication-overhead
+    lesson), after one discarded warmup pair; the instrumented runs carry an
+    exporter at 4 Hz plus the live aggregator/SLO tracker on the same loop.
+    Reports the MEDIAN same-run overhead pct (ceiling-gated ≤5% in
+    bench_floor.json) and the ``fleet_snapshot_ok`` flag: the post-run
+    merged fleet counter must equal the engine registry and the SLO tracker
+    must report a burn rate for the configured class.
+    """
+    import statistics
+
+    n = TELEMETRY_JOBS
+    asyncio.run(bench_scheduler(n_jobs=n))  # warmup: imports + allocator heat
+    overheads = []
+    last = {}
+    for _ in range(pairs):
+        plain = asyncio.run(bench_scheduler(n_jobs=n))
+        instr = asyncio.run(bench_scheduler(telemetry=True, n_jobs=n))
+        last = instr
+        if plain["jobs_per_sec"]:
+            overheads.append(
+                100.0 * (1.0 - instr["jobs_per_sec"] / plain["jobs_per_sec"]))
+    return {
+        "telemetry_overhead_pct": round(
+            statistics.median(overheads), 1) if overheads else 100.0,
+        "telemetry_overhead_runs": [round(o, 1) for o in overheads],
+        "fleet_snapshot_ok": last.get("fleet_snapshot_ok", 0.0),
+        "fleet_services": last.get("fleet_services", 0),
+        "slo_burn_rate_5m": last.get("slo_burn_rate_5m", -1.0),
+        "slo_state": last.get("slo_state", ""),
+    }
 
 
 def bench_replication_overhead(pairs: int = 5) -> dict:
@@ -1325,6 +1414,7 @@ def main() -> None:
     sb_pipe = asyncio.run(bench_statebus(True, sb_jobs))
     sb_perop = asyncio.run(bench_statebus(False, sb_jobs))
     sb_repl = bench_replication_overhead()
+    tele = bench_telemetry()
     sharded = asyncio.run(bench_sharded(shards, SB_PARTITIONS, sh_jobs))
     sharded_single = asyncio.run(bench_sharded(1, 1, sh_jobs))
     sel = bench_selection()
@@ -1355,6 +1445,11 @@ def main() -> None:
         # primary (async acks); same-run ratios so host speed cancels
         # (ceiling in bench_floor.json)
         **sb_repl,
+        # fleet telemetry plane (ISSUE 9): export overhead over interleaved
+        # plain/instrumented pairs + post-run fleet-snapshot correctness
+        # (merged counter == engine registry, SLO burn rate present);
+        # overhead ceiling + fleet_snapshot_ok floor live in bench_floor.json
+        **tele,
         # keyspace-sharded control plane (ISSUE 5): S scheduler-shard
         # processes over P statebus partition processes, vs the same
         # multi-process harness at 1×1

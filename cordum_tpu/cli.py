@@ -270,6 +270,63 @@ def cmd_trace(args) -> None:
         print("jobs: " + "  ".join(f"{j['job_id']}={j.get('state')}" for j in jobs))
 
 
+def cmd_traces(args) -> None:
+    """List recent trace ids with root span, duration and service count —
+    the entry point into the waterfall when you don't already know an id."""
+    with _client() as c:
+        doc = _check(c.get(f"/api/v1/traces?last={args.last}"))
+    traces = doc.get("traces") or []
+    if args.json:
+        _print(traces)
+        return
+    if not traces:
+        print("no traces recorded")
+        return
+    cols = ["trace_id", "root", "root_service", "spans", "services",
+            "duration_ms", "age_s"]
+    rows = [
+        {
+            "trace_id": t["trace_id"],
+            "root": t.get("root", ""),
+            "root_service": t.get("root_service", ""),
+            "spans": str(t.get("span_count", 0)),
+            "services": str(len(t.get("services") or [])),
+            "duration_ms": str(t.get("duration_ms", "")),
+            "age_s": str(t.get("age_s", "")),
+        }
+        for t in traces
+    ]
+    widths = {c_: max(len(c_), *(len(r[c_]) for r in rows)) for c_ in cols}
+    print("  ".join(c_.ljust(widths[c_]) for c_ in cols))
+    for r in rows:
+        print("  ".join(r[c_].ljust(widths[c_]) for c_ in cols))
+
+
+def cmd_top(args) -> None:
+    """Live fleet table from GET /api/v1/fleet: per-service health beacons,
+    fleet rates, SLO burn states.  Refreshes every --interval seconds;
+    --once renders a single frame (scripts, smoke tests)."""
+    from .obs.fleet import render_fleet_table
+
+    with _client() as c:
+        while True:
+            doc = _check(c.get("/api/v1/fleet"))
+            if args.json:
+                _print(doc)
+            else:
+                frame = render_fleet_table(doc)
+                if not args.once:
+                    # ANSI clear + home: refresh in place like top(1)
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(frame, flush=True)
+            if args.once:
+                return
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return
+
+
 def cmd_pack(args) -> None:
     from .packs import cli_pack
 
@@ -402,6 +459,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true", help="raw JSON instead of ASCII")
     sp.add_argument("--width", type=int, default=48)
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("traces", help="list recent traces (newest first)")
+    sp.add_argument("--last", type=int, default=20,
+                    help="how many recent traces to list")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_traces)
+
+    sp = sub.add_parser(
+        "top", help="live fleet telemetry table (GET /api/v1/fleet)")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds")
+    sp.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    sp.add_argument("--json", action="store_true",
+                    help="raw /api/v1/fleet JSON instead of the table")
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser(
         "statebus", help="statebus replication status / promote a replica")

@@ -33,6 +33,18 @@ async def main() -> None:
     wf_store = WorkflowStore(kv)
     wf_engine = WorkflowEngine(store=wf_store, bus=bus, mem=mem, schemas=schemas,
                                configsvc=configsvc, instance_id="gateway-wf")
+    # SLO objectives for the fleet telemetry plane come from the pools.yaml
+    # slo: stanza; an unreadable pool file must not stop the gateway
+    try:
+        from ..infra.config import load_pool_config
+
+        slo_config = load_pool_config(cfg.pool_config_path).slo
+    except Exception as e:  # noqa: BLE001 - telemetry config is best-effort
+        from ..infra import logging as logx
+
+        logx.warn("pool config unreadable; fleet SLO tracking disabled",
+                  path=cfg.pool_config_path, err=str(e))
+        slo_config = {}
     admin_keys = [k for k in os.environ.get("CORDUM_ADMIN_KEYS", "").split(",") if k]
     # CORDUM_KEY_TENANTS="key1:tenantA,key2:tenantB" scopes keys to tenants
     key_tenants: dict[str, str] = {}
@@ -52,6 +64,7 @@ async def main() -> None:
         rate_rps=_boot.env_float("API_RATE_LIMIT_RPS", 0.0),
         max_concurrent_runs=_boot.env_int("MAX_CONCURRENT_RUNS", 0),
         scheduler_shards=cfg.scheduler_shards,
+        slo_config=slo_config,
     )
     host, _, port = cfg.gateway_http_addr.partition(":")
     await gw.start(host or "127.0.0.1", int(port or 8081))

@@ -86,6 +86,31 @@ async def main() -> None:
     )
     reconciler = Reconciler(job_store, timeouts, instance_id=engine.instance_id)
     replayer = PendingReplayer(engine, job_store, timeouts)
+
+    # fleet telemetry plane (docs/OBSERVABILITY.md §Fleet telemetry): this
+    # shard's registry + a health beacon carrying its shard coordinates and
+    # live queue depth, plus the runtime profiler feeding loop/GC health
+    # into the same registry
+    from ..obs.profiler import RuntimeProfiler
+    from ..obs.telemetry import TelemetryExporter
+
+    profiler = RuntimeProfiler(metrics, service="scheduler")
+
+    def _telemetry_health() -> dict:
+        return {
+            "role": "scheduler",
+            "shard_index": engine.shard_index,
+            "shard_count": engine.shard_count,
+            "queue_depth": engine._inflight,
+            "jobs_scheduled": metrics.jobs_dispatched.total(),
+            "workers_live": len(registry.snapshot()),
+            **profiler.health(),
+        }
+
+    telemetry = TelemetryExporter(
+        "scheduler", bus, metrics,
+        instance_id=engine.instance_id, health_fn=_telemetry_health,
+    )
     overlay = ConfigOverlay(
         configsvc, strategy, reconciler,
         interval_s=_boot.env_float("SCHEDULER_CONFIG_RELOAD_INTERVAL", 30.0),
@@ -109,11 +134,15 @@ async def main() -> None:
     await replayer.start()
     await overlay.start()
     await snapshotter.start()
+    await telemetry.start()
+    await profiler.start()
     logx.info("scheduler running", instance=engine.instance_id,
               shard=engine.shard_index, shards=engine.shard_count)
     try:
         await _boot.wait_for_shutdown()
     finally:
+        await profiler.stop()
+        await telemetry.stop()
         await snapshotter.stop()
         await overlay.stop()
         await replayer.stop()

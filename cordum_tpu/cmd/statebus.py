@@ -106,6 +106,7 @@ async def main(argv=None) -> None:
             replica_of=args.replica_of, peers=peers,
             sync_replication=sync, auto_promote=not args.no_auto_promote,
             heartbeat_interval_s=hb_interval, heartbeat_timeout_s=hb_timeout,
+            partition=p if partitions > 1 or only >= 0 else -1,
         )
         for p in indices
     ]

@@ -31,6 +31,9 @@ if os.environ.get("CORDUM_FORCE_CPU") == "1":
     jax.config.update("jax_platforms", "cpu")
 
 from ..infra.memstore import MemoryStore
+from ..infra.metrics import Metrics
+from ..obs.profiler import RuntimeProfiler
+from ..obs.telemetry import TelemetryExporter
 from ..worker.handlers import attach_default_tpu_worker
 from ..worker.runtime import Worker
 from . import _boot
@@ -68,8 +71,12 @@ async def main() -> None:
         region=env.get("WORKER_REGION", ""),
     )
     pool = _pool_limits(cfg, pool_name)
+    # one registry shared by the batcher, the serving engine and the fleet
+    # telemetry exporter, so worker-side metrics reach the aggregator
+    metrics = Metrics()
     attach_default_tpu_worker(
         worker,
+        metrics=metrics,
         tp=_boot.env_int("WORKER_TP", 1),
         batching=env.get("WORKER_BATCHING", "1") != "0",
         max_batch_rows=_boot.env_int("WORKER_MAX_BATCH_SIZE", 0)
@@ -86,10 +93,19 @@ async def main() -> None:
         serving_max_new_tokens=_boot.env_int("WORKER_SERVING_MAX_NEW_TOKENS", 0)
         or (pool.serving_max_new_tokens if pool else 0) or 64,
     )
+    profiler = RuntimeProfiler(metrics, service="worker")
+    telemetry = TelemetryExporter(
+        "worker", bus, metrics, instance_id=worker.worker_id,
+        health_fn=lambda: {**worker.telemetry_health(), **profiler.health()},
+    )
     await worker.start()
+    await telemetry.start()
+    await profiler.start()
     try:
         await _boot.wait_for_shutdown()
     finally:
+        await profiler.stop()
+        await telemetry.stop()
         await worker.stop()
         await conn.close()
 

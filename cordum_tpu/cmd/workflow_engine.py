@@ -27,10 +27,25 @@ async def main() -> None:
         instance_id=os.environ.get("WF_ENGINE_ID", "wf-engine-0"),
         reconcile_interval_s=_boot.env_float("WF_RECONCILE_INTERVAL", 5.0),
     )
+    from ..infra.metrics import Metrics
+    from ..obs.profiler import RuntimeProfiler
+    from ..obs.telemetry import TelemetryExporter
+
+    metrics = Metrics()
+    profiler = RuntimeProfiler(metrics, service="workflow-engine")
+    telemetry = TelemetryExporter(
+        "workflow-engine", bus, metrics,
+        instance_id=os.environ.get("WF_ENGINE_ID", "wf-engine-0"),
+        health_fn=lambda: {"role": "workflow-engine", **profiler.health()},
+    )
     await svc.start()
+    await telemetry.start()
+    await profiler.start()
     try:
         await _boot.wait_for_shutdown()
     finally:
+        await profiler.stop()
+        await telemetry.stop()
         await svc.stop()
         await conn.close()
 

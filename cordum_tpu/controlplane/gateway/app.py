@@ -46,6 +46,10 @@ from ...infra.schemareg import SchemaError, SchemaRegistry
 from ...infra.secrets import contains_secret_refs
 from ...obs.assembler import assemble
 from ...obs.collector import SpanCollector
+from ...obs.fleet import FleetAggregator
+from ...obs.profiler import RuntimeProfiler
+from ...obs.slo import SLOTracker
+from ...obs.telemetry import TelemetryExporter
 from ...obs.tracer import Tracer
 from ...protocol import subjects as subj
 from ...protocol.jobhash import job_hash
@@ -105,6 +109,8 @@ class Gateway:
         ws_allowed_origins: Optional[list[str]] = None,
         instance_id: str = "gateway-0",
         scheduler_shards: int = 1,
+        slo_config: Optional[dict] = None,
+        telemetry: bool = True,
     ):
         self.kv = kv
         self.bus = bus
@@ -127,6 +133,22 @@ class Gateway:
         # (stage histograms land there) and serves the trace API from the
         # same KV the collector writes
         self.span_collector = SpanCollector(kv, bus, metrics=self.metrics)
+        # ... and the fleet telemetry plane (ISSUE 9): the aggregator merges
+        # every process's sys.telemetry.<service> snapshots into the fleet
+        # view (/api/v1/fleet, /metrics?scope=fleet, cordumctl top); the SLO
+        # tracker burns the pools.yaml slo: objectives against it; the
+        # gateway exports its own registry + runs the runtime profiler like
+        # any other process
+        self.fleet = FleetAggregator(bus, metrics=self.metrics)
+        self.slo_tracker = SLOTracker.from_config(
+            slo_config or {}, metrics=self.metrics
+        )
+        self.profiler = RuntimeProfiler(self.metrics, service="gateway")
+        self._telemetry_enabled = telemetry
+        self.telemetry = TelemetryExporter(
+            "gateway", bus, self.metrics, instance_id=instance_id,
+            health_fn=self._telemetry_health,
+        )
         self.rate = TokenBucket(rate_rps)
         self.max_concurrent_runs = max_concurrent_runs
         self.ws_allowed_origins = ws_allowed_origins
@@ -211,7 +233,9 @@ class Gateway:
         r.add_post(f"{v1}/context/window", self.context_window)
         r.add_post(f"{v1}/context/memory/{{memory_id}}", self.context_update)
         r.add_put(f"{v1}/context/chunks/{{memory_id}}", self.context_chunks)
+        r.add_get(f"{v1}/traces", self.list_traces)
         r.add_get(f"{v1}/traces/{{trace_id}}", self.get_trace)
+        r.add_get(f"{v1}/fleet", self.get_fleet)
         r.add_get(f"{v1}/workers", self.get_workers)
         r.add_get(f"{v1}/status", self.get_status)
         r.add_get(f"{v1}/stream", self.ws_stream)
@@ -285,6 +309,10 @@ class Gateway:
         self._subs.append(await self.bus.subscribe(subj.JOB_EVENTS_WILDCARD, self._tap_events))
         self._subs.append(await self.bus.subscribe(subj.WORKFLOW_EVENT, self._tap_events))
         await self.span_collector.start()
+        if self._telemetry_enabled:
+            await self.fleet.start()
+            await self.telemetry.start()
+            await self.profiler.start()
         if self.registry is not None:
             self._subs.append(await self.bus.subscribe(subj.HEARTBEAT, self._tap_heartbeat))
         self._runner = web.AppRunner(self.app)
@@ -297,6 +325,10 @@ class Gateway:
         for s in self._subs:
             s.unsubscribe()
         self._subs = []
+        if self._telemetry_enabled:
+            await self.profiler.stop()
+            await self.telemetry.stop()
+            await self.fleet.stop()
         await self.span_collector.stop()
         for ws in list(self._ws_clients):
             await ws.close()
@@ -471,6 +503,9 @@ class Gateway:
                     "principal_id": principal.principal_id,
                     "context_ptr": ctx_ptr,
                     "trace_id": trace_id,
+                    # SLO job class: the result path labels the class-split
+                    # e2e/terminal metrics from this persisted field
+                    "priority": req.priority,
                     "submitted_at_us": str(now_us()),
                 },
                 event="submit",
@@ -1265,7 +1300,35 @@ class Gateway:
     async def healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
 
+    def _telemetry_health(self) -> dict:
+        return {
+            "role": "gateway",
+            "ws_clients": len(self._ws_clients),
+            "scheduler_shards": self.scheduler_shards,
+            **self.profiler.health(),
+        }
+
+    async def get_fleet(self, request: web.Request) -> web.Response:
+        """``GET /api/v1/fleet`` — per-service health beacons, fleet-wide
+        rates and stage latencies, SLO burn states (docs/OBSERVABILITY.md
+        §Fleet telemetry)."""
+        return web.json_response(self.fleet.fleet_doc(self.slo_tracker))
+
+    async def list_traces(self, request: web.Request) -> web.Response:
+        """``GET /api/v1/traces?last=N`` — newest trace summaries from the
+        collector index (`cordum traces --last N`)."""
+        n = min(200, max(1, int(request.query.get("last", "20"))))
+        return web.json_response(
+            {"traces": await self.span_collector.recent(n)}
+        )
+
     async def get_metrics(self, request: web.Request) -> web.Response:
+        # ?scope=fleet: the aggregator's fleet-merged exposition (counters/
+        # histograms summed across processes, gauges per instance)
+        if request.query.get("scope") == "fleet":
+            return web.Response(
+                text=self.fleet.render(), content_type="text/plain"
+            )
         return web.Response(text=self.metrics.render(), content_type="text/plain")
 
     async def ws_stream(self, request: web.Request) -> web.WebSocketResponse:

@@ -721,9 +721,13 @@ class Engine:
                 # the same way); its future already carries the error
                 continue
             self.metrics.jobs_completed.inc(status=it.state.value)
+            klass = it.snap.get("priority", "") or "BATCH"
+            self.metrics.jobs_by_class.inc(job_class=klass, status=it.state.value)
             sub_us = int(it.snap.get("submitted_at_us", "0") or 0)
             if sub_us:
-                self.metrics.e2e_latency.observe(max(0.0, (now_us() - sub_us) / 1e6))
+                self.metrics.e2e_latency.observe(
+                    max(0.0, (now_us() - sub_us) / 1e6), job_class=klass
+                )
             if it.state in (JobState.FAILED, JobState.TIMEOUT):
                 req = await self.job_store.get_request(it.res.job_id)
                 if req is not None:
@@ -1239,9 +1243,15 @@ class Engine:
             res.job_id, [(state, fields, "result")], snap=snap
         )
         self.metrics.jobs_completed.inc(status=state.value)
+        # SLO class = the persisted submit-time priority (obs/slo.py reads
+        # the class-labeled series fleet-wide)
+        klass = snap.get("priority", "") or "BATCH"
+        self.metrics.jobs_by_class.inc(job_class=klass, status=state.value)
         sub_us = int(snap.get("submitted_at_us", "0") or 0)
         if sub_us:
-            self.metrics.e2e_latency.observe(max(0.0, (now_us() - sub_us) / 1e6))
+            self.metrics.e2e_latency.observe(
+                max(0.0, (now_us() - sub_us) / 1e6), job_class=klass
+            )
         if state in (JobState.FAILED, JobState.TIMEOUT):
             req = await self.job_store.get_request(res.job_id)
             if req is not None:

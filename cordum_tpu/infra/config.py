@@ -95,6 +95,10 @@ class PoolConfig:
     # env vars win): partitions, replicas-per-partition, sync_replication,
     # heartbeat_timeout_s — docs/PROTOCOL.md §Replication
     statebus: dict = field(default_factory=dict)
+    # slo: per-job-class objectives (name → {job_class, latency_ms,
+    # latency_target, availability_target}) consumed by the gateway's
+    # SLOTracker (cordum_tpu/obs/slo.py)
+    slo: dict = field(default_factory=dict)
 
     def pools_for_topic(self, topic: str) -> list[Pool]:
         names = self.topics.get(topic)
@@ -136,6 +140,7 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
         cfg.topics[topic] = list(pools or [])
     cfg.scheduler_shards = max(1, int((doc.get("scheduler") or {}).get("shards") or 1))
     cfg.statebus = dict(doc.get("statebus") or {})
+    cfg.slo = dict(doc.get("slo") or {})
     return cfg
 
 

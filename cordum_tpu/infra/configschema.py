@@ -88,6 +88,27 @@ POOLS_SCHEMA: dict[str, Any] = {
             },
             "additionalProperties": False,
         },
+        # SLO objectives per job class (cordum_tpu/obs/slo.py): the gateway's
+        # SLOTracker evaluates multi-window burn rates against these from the
+        # fleet-aggregated series (docs/OBSERVABILITY.md §Fleet telemetry)
+        "slo": {
+            "type": "object",
+            "additionalProperties": {
+                "type": "object",
+                "properties": {
+                    "job_class": {"type": "string"},
+                    "latency_ms": {"type": "number", "exclusiveMinimum": 0},
+                    "latency_target": {
+                        "type": "number", "minimum": 0, "exclusiveMaximum": 1,
+                    },
+                    "availability_target": {
+                        "type": "number", "minimum": 0, "exclusiveMaximum": 1,
+                    },
+                },
+                "required": ["latency_ms"],
+                "additionalProperties": False,
+            },
+        },
         # tolerated here so one file can carry pools + reconciler (dev mode)
         "reconciler": {"type": "object"},
     },

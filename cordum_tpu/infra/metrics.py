@@ -18,11 +18,29 @@ _DEFAULT_BUCKETS = (
 )
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash first, then
+    double-quote and newline (exposition format spec)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(str(v))}"' for k, v in sorted(labels.items())
+    )
     return "{" + inner + "}"
+
+
+def _fmt_le(bound: float) -> str:
+    """Histogram ``le`` bound as a plain float literal (``repr()`` of an
+    int-typed bucket rendered ``1`` vs ``1.0`` and float noise rendered as
+    full 17-digit repr; conformance parsers want canonical float text)."""
+    f = float(bound)
+    if f == int(f):
+        return f"{f:.1f}"  # 1.0, 2.0 — the canonical Prometheus spelling
+    return f"{f:g}"
 
 
 class Counter:
@@ -108,18 +126,21 @@ class Histogram:
                 return self.buckets[i]
         return self.buckets[-1]
 
-    def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+    def _snapshot(self) -> list[tuple[tuple[tuple[str, str], ...], list[int], float, int]]:
         with self._lock:
-            snap = [
+            return [
                 (key, list(self._counts[key]), self._sums[key], self._totals[key])
                 for key in sorted(self._totals)
             ]
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        snap = self._snapshot()
         for key, counts, sum_, total in snap:
             labels = dict(key)
             for i, b in enumerate(self.buckets):
                 bl = dict(labels)
-                bl["le"] = repr(b)
+                bl["le"] = _fmt_le(b)
                 out.append(f"{self.name}_bucket{_fmt_labels(bl)} {counts[i]}")
             bl = dict(labels)
             bl["le"] = "+Inf"
@@ -287,6 +308,50 @@ class Metrics:
             "Session-keyed routing outcomes (hit = routed to the worker "
             "holding the session's KV pages)",
         )
+        # fleet telemetry plane (cordum_tpu/obs, ISSUE 9): retention-cap
+        # drops made observable, per-class SLO measurement, exporter flow,
+        # and the runtime profiler's loop/GC health
+        self.spans_dropped = Counter(
+            "cordum_spans_dropped_total",
+            "Spans dropped by the collector's retention caps, by reason "
+            "(per_trace_cap | trace_evicted | trace_purged)",
+        )
+        self.telemetry_snapshots = Counter(
+            "cordum_telemetry_snapshots_total",
+            "Telemetry snapshots published by this process's exporter",
+        )
+        self.telemetry_dropped = Counter(
+            "cordum_telemetry_snapshots_dropped_total",
+            "Telemetry snapshots lost, by reason (publish_error | "
+            "decode_error | instance_evicted)",
+        )
+        self.jobs_by_class = Counter(
+            "cordum_jobs_completed_by_class_total",
+            "Terminal jobs by SLO job class (JobRequest.priority) and status",
+        )
+        self.slo_burn_rate = Gauge(
+            "cordum_slo_burn_rate",
+            "SLO error-budget burn rate per objective and window "
+            "(1.0 = burning exactly the budget)",
+        )
+        self.eventloop_lag = Histogram(
+            "cordum_eventloop_lag_seconds",
+            "Event-loop scheduling lag sampled by the runtime profiler",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+        )
+        self.slow_ticks = Counter(
+            "cordum_slow_ticks_total",
+            "Profiler ticks whose event-loop lag exceeded the slow-tick "
+            "threshold (each dumps the running task stacks to the log)",
+        )
+        self.gc_pauses = Counter(
+            "cordum_gc_pauses_total", "GC collections observed, by generation"
+        )
+        self.gc_pause_seconds = Histogram(
+            "cordum_gc_pause_seconds",
+            "Stop-the-world GC pause durations",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25),
+        )
         self._families = [
             self.jobs_received,
             self.jobs_dispatched,
@@ -330,6 +395,15 @@ class Metrics:
             self.serving_sessions,
             self.serving_kv_pages_in_use,
             self.session_affinity,
+            self.spans_dropped,
+            self.telemetry_snapshots,
+            self.telemetry_dropped,
+            self.jobs_by_class,
+            self.slo_burn_rate,
+            self.eventloop_lag,
+            self.slow_ticks,
+            self.gc_pauses,
+            self.gc_pause_seconds,
         ]
 
     def render(self) -> str:
@@ -337,3 +411,34 @@ class Metrics:
         for fam in self._families:
             lines.extend(fam.render())
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """The whole registry in the compact fleet-telemetry snapshot format
+        (msgpack-friendly plain lists/dicts; docs/OBSERVABILITY.md §Fleet
+        telemetry)::
+
+            {"counters":   {name: [[{label: value}, value], ...]},
+             "gauges":     {name: [[{label: value}, value], ...]},
+             "histograms": {name: {"buckets": [...],
+                                   "series": [[{..}, [counts], sum, total]]}}}
+
+        Gauges are separated from counters because they merge differently
+        across the fleet (counters sum; gauges keep their instance).
+        """
+        counters: dict[str, list] = {}
+        gauges: dict[str, list] = {}
+        hists: dict[str, dict] = {}
+        for fam in self._families:
+            if isinstance(fam, Histogram):
+                hists[fam.name] = {
+                    "buckets": list(fam.buckets),
+                    "series": [
+                        [dict(key), counts, sum_, total]
+                        for key, counts, sum_, total in fam._snapshot()
+                    ],
+                }
+            elif isinstance(fam, Gauge):
+                gauges[fam.name] = [[dict(k), v] for k, v in fam._snapshot()]
+            else:
+                counters[fam.name] = [[dict(k), v] for k, v in fam._snapshot()]
+        return {"counters": counters, "gauges": gauges, "histograms": hists}

@@ -103,9 +103,14 @@ class StateBusServer:
                  replica_of: str = "", peers: tuple = (),
                  sync_replication: bool = False, auto_promote: bool = True,
                  heartbeat_interval_s: float = 1.0,
-                 heartbeat_timeout_s: float = 3.0) -> None:
+                 heartbeat_timeout_s: float = 3.0,
+                 partition: int = -1) -> None:
         self.host = host
         self.port = port
+        # keyspace partition index this server serves (-1 = standalone);
+        # rides the telemetry health beacon so the fleet view can group
+        # primaries/replicas per partition
+        self.partition = partition
         self.kv = MemoryKV()
         self.aof_path = aof_path
         self._aof = None
@@ -136,6 +141,7 @@ class StateBusServer:
         self._replica_link: Optional[ReplicaLink] = None
         self._hb_task: Optional[asyncio.Task] = None
         self._last_peer_probe = 0.0
+        self._telemetry = None  # TelemetryExporter, created at start()
 
     # -- lifecycle ------------------------------------------------------
     async def start(self) -> None:
@@ -156,8 +162,25 @@ class StateBusServer:
             # promotion: no split-brain dual-accept)
             await self._probe_peers()
         self._hb_task = asyncio.ensure_future(self._hb_loop())
+        # fleet telemetry beacon: the statebus IS the bus, so its exporter
+        # routes snapshots straight to this server's own subscribers (the
+        # gateway's sys.telemetry.> wildcard subscribes on every partition)
+        from ..obs.telemetry import TelemetryExporter
+
+        async def _pub(subject: str, pkt: BusPacket) -> None:
+            await self._route(subject, pkt.to_wire())
+
+        self._telemetry = TelemetryExporter(
+            "statebus", None, self.metrics,
+            instance_id=f"statebus-{self.host}:{self.port}",
+            health_fn=self._telemetry_health, publish=_pub,
+        )
+        await self._telemetry.start()
 
     async def stop(self, *, graceful: bool = True) -> None:
+        if self._telemetry is not None:
+            exporter, self._telemetry = self._telemetry, None
+            await exporter.stop()
         if self._hb_task is not None:
             task, self._hb_task = self._hb_task, None
             task.cancel()
@@ -419,6 +442,26 @@ class StateBusServer:
                 if now - self._last_peer_probe >= self.heartbeat_timeout_s:
                     self._last_peer_probe = now
                     await self._probe_peers()
+
+    def _telemetry_health(self) -> dict:
+        """Beacon fields for the fleet view: replication role/epoch/offset
+        plus worst attached-replica lag (primary) or link lag (replica)."""
+        doc = {
+            "role": f"statebus-{self.role}",
+            "partition": self.partition,
+            "endpoint": f"{self.host}:{self.port}",
+            "epoch": self.repl.epoch,
+            "offset": self.repl.offset,
+            "sync": self.sync_replication,
+            "replicas": len(self.repl.sessions),
+        }
+        link = self._replica_link
+        if link is not None:
+            doc["lag_ops"] = max(0, link.primary_offset - self.repl.offset)
+        elif self.repl.sessions:
+            lags = [r.get("lag_ops", 0) for r in self.repl.status()["replicas"]]
+            doc["lag_ops"] = max(lags) if lags else 0
+        return doc
 
     def _role_doc(self) -> dict:
         doc = {

@@ -1,6 +1,6 @@
-"""Flight recorder: span-based distributed tracing for the control plane.
+"""Observability: the flight recorder + the fleet telemetry plane.
 
-Three pieces:
+Flight recorder (per-request):
 
 * :mod:`tracer` — create/finish :class:`~cordum_tpu.protocol.types.Span`
   objects, propagate span context through ``contextvars`` inside a process
@@ -12,18 +12,41 @@ Three pieces:
 * :mod:`assembler` — rebuild the span tree, compute per-stage durations and
   the critical path, render ASCII waterfalls for the CLI.
 
+Fleet telemetry plane (per-fleet, ISSUE 9):
+
+* :mod:`telemetry` — per-process exporter publishing delta-encoded metric
+  snapshots + health beacons on ``sys.telemetry.<service>``.
+* :mod:`fleet` — gateway-hosted aggregator merging counters/histograms
+  fleet-wide (gauges keep their instance) with short time-series rings;
+  serves ``/metrics?scope=fleet``, ``GET /api/v1/fleet``, ``cordumctl top``.
+* :mod:`slo` — multi-window (5 m / 1 h) error-budget burn rates per job
+  class from the aggregated series (pools.yaml ``slo:`` stanza).
+* :mod:`profiler` — event-loop lag sampler, slow-tick stack dumps with the
+  active trace id, GC-pause counters.
+
 See docs/OBSERVABILITY.md for the end-to-end story.
 """
 from __future__ import annotations
 
 from .assembler import assemble, render_waterfall
 from .collector import SpanCollector
-from .tracer import Tracer, current_trace_context
+from .fleet import FleetAggregator, render_fleet_table
+from .profiler import RuntimeProfiler
+from .slo import SLOObjective, SLOTracker
+from .telemetry import TelemetryExporter
+from .tracer import Tracer, current_trace_context, last_active_context
 
 __all__ = [
+    "FleetAggregator",
+    "RuntimeProfiler",
+    "SLOObjective",
+    "SLOTracker",
     "SpanCollector",
+    "TelemetryExporter",
     "Tracer",
     "assemble",
     "current_trace_context",
+    "last_active_context",
+    "render_fleet_table",
     "render_waterfall",
 ]

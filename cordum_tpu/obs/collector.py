@@ -77,9 +77,19 @@ class SpanCollector:
 
     async def add(self, sp: Span) -> None:
         key = spans_key(sp.trace_id)
-        await self.kv.rpush(key, json.dumps(sp.to_dict(), sort_keys=True).encode())
-        # ring-buffer retention: keep the newest max_spans_per_trace
-        await self.kv.ltrim(key, -self.max_spans_per_trace, -1)
+        length = await self.kv.rpush(
+            key, json.dumps(sp.to_dict(), sort_keys=True).encode()
+        )
+        # ring-buffer retention: keep the newest max_spans_per_trace; the
+        # drop is counted so silent truncation is observable
+        # (cordum_spans_dropped_total — platform_smoke asserts it stays 0)
+        if length > self.max_spans_per_trace:
+            await self.kv.ltrim(key, -self.max_spans_per_trace, -1)
+            if self.metrics is not None:
+                self.metrics.spans_dropped.inc(
+                    amount=float(length - self.max_spans_per_trace),
+                    reason="per_trace_cap",
+                )
         await self.kv.expire(key, self.trace_ttl_s)
         await self.kv.zadd(INDEX_KEY, sp.trace_id, float(now_us()))
         await self._evict_over_cap()
@@ -95,9 +105,17 @@ class SpanCollector:
             return
         oldest = await self.kv.zrange(INDEX_KEY, 0, over - 1)
         for tid in oldest:
-            await self.kv.delete(spans_key(tid))
-            await self.kv.zrem(INDEX_KEY, tid)
+            await self._drop_trace(tid, reason="trace_evicted")
         logx.debug("span collector evicted traces", count=len(oldest))
+
+    async def _drop_trace(self, trace_id: str, *, reason: str) -> None:
+        key = spans_key(trace_id)
+        if self.metrics is not None:
+            n = await self.kv.llen(key)
+            if n:
+                self.metrics.spans_dropped.inc(amount=float(n), reason=reason)
+        await self.kv.delete(key)
+        await self.kv.zrem(INDEX_KEY, trace_id)
 
     # ------------------------------------------------------------------
     # read side (gateway trace API / bench)
@@ -118,6 +136,32 @@ class SpanCollector:
         """Drop traces whose last span landed at or before ``cutoff_us``."""
         stale = await self.kv.zrangebyscore(INDEX_KEY, 0, float(cutoff_us))
         for tid in stale:
-            await self.kv.delete(spans_key(tid))
-            await self.kv.zrem(INDEX_KEY, tid)
+            await self._drop_trace(tid, reason="trace_purged")
         return len(stale)
+
+    async def recent(self, n: int = 20) -> list[dict]:
+        """The newest ``n`` traces as summaries (`cordum traces --last N`):
+        trace id, root span name/service, span count, service count, wall
+        duration, last-write age."""
+        ids = await self.kv.zrange(INDEX_KEY, 0, max(0, n - 1), desc=True)
+        out = []
+        for tid in ids:
+            spans = await self.spans(tid)
+            if not spans:
+                continue
+            root = next(
+                (s for s in spans if not s.parent_span_id),
+                min(spans, key=lambda s: s.start_us),
+            )
+            start = min(s.start_us for s in spans)
+            end = max(s.end_us or s.start_us for s in spans)
+            out.append({
+                "trace_id": tid,
+                "root": root.name,
+                "root_service": root.service,
+                "span_count": len(spans),
+                "services": sorted({s.service for s in spans if s.service}),
+                "duration_ms": round((end - start) / 1000.0, 3),
+                "age_s": round(max(0, now_us() - end) / 1e6, 1),
+            })
+        return out

@@ -34,12 +34,24 @@ _CTX: contextvars.ContextVar[tuple[str, str]] = contextvars.ContextVar(
     "cordum_span_ctx", default=("", "")
 )
 
+# last span ANY task entered, readable across tasks/threads: contextvars are
+# task-local, so the runtime profiler's slow-tick dump (which runs in its own
+# task while the stalled work is suspended) could never see the stalled
+# task's _CTX — this module-level echo is the cross-task best-effort view
+_LAST_ACTIVE: list[str] = ["", ""]
+
 
 def current_trace_context() -> tuple[str, str]:
     """→ ``(trace_id, span_id)`` of the active span ("" when untraced).
     Used to propagate context into side channels the bus doesn't carry,
     e.g. the remote safety-kernel HTTP headers."""
     return _CTX.get()
+
+
+def last_active_context() -> tuple[str, str]:
+    """→ the last ``(trace_id, span_id)`` any span in this process entered
+    (cross-task; the profiler's slow-tick attribution)."""
+    return (_LAST_ACTIVE[0], _LAST_ACTIVE[1])
 
 
 TRACE_HEADER = "X-Cordum-Trace-Id"
@@ -142,6 +154,8 @@ class Tracer:
         prev = _CTX.get() if sp.trace_id else None
         if sp.trace_id:
             _CTX.set((sp.trace_id, sp.span_id))
+            _LAST_ACTIVE[0] = sp.trace_id
+            _LAST_ACTIVE[1] = sp.span_id
         status = SPAN_OK
         try:
             yield sp

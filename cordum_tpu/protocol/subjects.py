@@ -11,6 +11,19 @@ WORKFLOW_EVENT = "sys.workflow.event"
 JOB_EVENTS_WILDCARD = "sys.job.>"  # every job lifecycle event (gateway tap)
 TRACE_SPAN = "sys.trace.span"  # finished flight-recorder spans → collector
 
+# Fleet telemetry plane (docs/OBSERVABILITY.md §Fleet telemetry): every
+# process publishes periodic metric snapshots + a health beacon on
+# ``sys.telemetry.<service>``; the gateway-hosted FleetAggregator consumes
+# the wildcard.  Deliberately NOT durable: a snapshot is stale the moment
+# the next one lands, so redelivery would only add load.
+TELEMETRY_PREFIX = "sys.telemetry."
+TELEMETRY_WILDCARD = "sys.telemetry.>"
+
+
+def telemetry_subject(service: str) -> str:
+    """Telemetry snapshot subject for a service (``sys.telemetry.<service>``)."""
+    return f"{TELEMETRY_PREFIX}{service}"
+
 JOB_PREFIX = "job."
 WORKER_PREFIX = "worker."
 

@@ -344,6 +344,32 @@ class SystemAlert(WireModel):
     labels: dict[str, str] = field(default_factory=dict)
 
 
+@dataclass
+class TelemetrySnapshot(WireModel):
+    """Periodic per-process metric snapshot + health beacon (the fleet
+    telemetry plane's wire unit, docs/OBSERVABILITY.md §Fleet telemetry).
+
+    Published on ``sys.telemetry.<service>`` every ``interval_s`` seconds by
+    the :class:`~cordum_tpu.obs.telemetry.TelemetryExporter` embedded in each
+    process.  ``metrics`` carries the process's ``Metrics`` registry in the
+    compact snapshot format (``Metrics.snapshot()``), delta-encoded: only
+    series whose value changed since the previous publish ride the wire,
+    with a periodic ``full=True`` snapshot so a late-joining aggregator
+    converges on gauges and quiet series.  ``started_at_us`` is the process
+    epoch — a change at constant (service, instance) is a restart, which is
+    how the aggregator detects counter resets."""
+
+    service: str = ""  # gateway / scheduler / statebus / worker / ...
+    instance: str = ""  # unique per process (instance_id, endpoint, ...)
+    seq: int = 0  # snapshot sequence within this process epoch
+    started_at_us: int = 0  # process start (restart/reset detection)
+    uptime_s: float = 0.0
+    interval_s: float = 0.0  # configured publish cadence (staleness bound)
+    full: bool = False  # full registry snapshot vs changed-series delta
+    health: dict[str, Any] = field(default_factory=dict)  # role beacon
+    metrics: dict[str, Any] = field(default_factory=dict)  # Metrics.snapshot()
+
+
 SPAN_OK = "OK"
 SPAN_ERROR = "ERROR"
 
@@ -445,6 +471,7 @@ _PAYLOAD_TYPES: dict[str, type] = {
     "job_cancel": JobCancel,
     "system_alert": SystemAlert,
     "span": Span,
+    "telemetry": TelemetrySnapshot,
 }
 # O(1) reverse lookup for wrap() (exact types only; payloads are always the
 # concrete dataclasses, and wrap() keeps an isinstance fallback for subclasses)
@@ -636,6 +663,10 @@ class BusPacket(WireModel):
     @property
     def span(self) -> Optional[Span]:
         return self.payload if self.kind == "span" else None
+
+    @property
+    def telemetry(self) -> Optional[TelemetrySnapshot]:
+        return self.payload if self.kind == "telemetry" else None
 
 
 # nested-field converters for WireModel.from_dict

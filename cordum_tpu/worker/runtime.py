@@ -459,6 +459,31 @@ class Worker:
         )
 
     # ------------------------------------------------------------------
+    def telemetry_health(self) -> dict:
+        """Health beacon for the fleet telemetry exporter (cmd/worker):
+        live load + the stateful engines' occupancy."""
+        out = {
+            "role": "worker",
+            "worker_id": self.worker_id,
+            "pool": self.pool,
+            "active_jobs": len(self._active),
+            "max_parallel_jobs": self.max_parallel_jobs,
+            "duty_cycle_pct": round(self._duty_cycle_peek(), 1),
+        }
+        if self._serving is not None:
+            out["serving_sessions"] = self._serving.active_sessions()
+        return out
+
+    def _duty_cycle_peek(self) -> float:
+        """Duty cycle over the current window WITHOUT resetting it (the
+        heartbeat's `_duty_cycle` owns the reset)."""
+        now = time.monotonic()
+        busy = self._busy_accum
+        if self._busy_since is not None:
+            busy += now - self._busy_since
+        return min(100.0, 100.0 * busy / max(now - self._window_start, 1e-6))
+
+    # ------------------------------------------------------------------
     def _mark_busy(self) -> None:
         if self._busy_since is None and self._active:
             self._busy_since = time.monotonic()

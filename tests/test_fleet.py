@@ -1,0 +1,587 @@
+"""Fleet telemetry plane (ISSUE 9): exporter delta encoding, cross-process
+aggregation correctness (merged counter == per-process sum, merged-histogram
+quantiles == union-stream quantiles, counter-reset detection on restart),
+SLO burn rates, the runtime profiler, Prometheus text-format conformance,
+span-drop accounting, and the gateway's fleet surfaces."""
+import asyncio
+import gc
+import random
+import time
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from cordum_tpu.controlplane.gateway.app import Gateway
+from cordum_tpu.controlplane.gateway.auth import BasicAuthProvider
+from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+from cordum_tpu.infra.bus import LoopbackBus
+from cordum_tpu.infra.config import parse_pool_config
+from cordum_tpu.infra.configschema import ConfigError
+from cordum_tpu.infra.jobstore import JobStore
+from cordum_tpu.infra.kv import MemoryKV
+from cordum_tpu.infra.memstore import MemoryStore
+from cordum_tpu.infra.metrics import Histogram, Metrics
+from cordum_tpu.infra.schemareg import SchemaRegistry
+from cordum_tpu.obs import (
+    FleetAggregator,
+    RuntimeProfiler,
+    SLOTracker,
+    SpanCollector,
+    TelemetryExporter,
+    render_fleet_table,
+)
+from cordum_tpu.protocol import subjects as subj
+from cordum_tpu.protocol.types import BusPacket, Span
+from cordum_tpu.utils.ids import now_us
+from cordum_tpu.workflow.engine import Engine as WorkflowEngine
+from cordum_tpu.workflow.store import WorkflowStore
+
+POLICY = {"tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}, "rules": []}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format conformance (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _parse_exposition(text: str) -> dict:
+    """Minimal conformance parser for the Prometheus text format: returns
+    {metric_name: {frozenset(label items): value}} and raises on malformed
+    lines/labels (unterminated quotes, raw newlines, bad floats)."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            labels_part, value_part = rest.rsplit("}", 1)
+            labels = {}
+            i = 0
+            while i < len(labels_part):
+                eq = labels_part.index("=", i)
+                key = labels_part[i:eq]
+                assert labels_part[eq + 1] == '"', f"unquoted value in {line!r}"
+                j = eq + 2
+                buf = []
+                while True:
+                    ch = labels_part[j]
+                    if ch == "\\":
+                        esc = labels_part[j + 1]
+                        buf.append({"n": "\n", '"': '"', "\\": "\\"}[esc])
+                        j += 2
+                    elif ch == '"':
+                        break
+                    else:
+                        buf.append(ch)
+                        j += 1
+                labels[key] = "".join(buf)
+                i = j + 1
+                if i < len(labels_part) and labels_part[i] == ",":
+                    i += 1
+            value = float(value_part.strip())
+        else:
+            name, value_s = line.rsplit(" ", 1)
+            name = name.strip()
+            labels = {}
+            value = float(value_s)
+        out.setdefault(name, {})[frozenset(labels.items())] = value
+    return out
+
+
+def test_label_value_escaping_round_trips():
+    m = Metrics()
+    nasty = 'a"b\\c\nd'
+    m.jobs_received.inc(topic=nasty)
+    parsed = _parse_exposition(m.render())
+    series = parsed["cordum_jobs_received_total"]
+    assert series[frozenset({("topic", nasty)}.union())] == 1.0
+
+
+def test_histogram_le_bounds_are_plain_floats():
+    h = Histogram("h_test", buckets=(0.25, 1.0, 2.5))
+    h.observe(0.3)
+    text = "\n".join(h.render())
+    parsed = _parse_exposition(text)
+    les = sorted(
+        dict(k)["le"] for k in parsed["h_test_bucket"]
+    )
+    assert les == ["+Inf", "0.25", "1.0", "2.5"], les
+    # every le except +Inf parses as a float
+    for le in les:
+        if le != "+Inf":
+            float(le)
+
+
+def test_full_registry_renders_parseable():
+    m = Metrics()
+    m.jobs_dispatched.inc(topic="job.x")
+    m.e2e_latency.observe(0.2, job_class="BATCH")
+    m.workers_live.set(3.0)
+    parsed = _parse_exposition(m.render())
+    assert parsed["cordum_jobs_dispatched_total"][frozenset({("topic", "job.x")})] == 1.0
+    assert parsed["cordum_workers_live"][frozenset()] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# exporter delta encoding
+# ---------------------------------------------------------------------------
+
+
+def test_exporter_delta_only_ships_changed_series():
+    m = Metrics()
+    exp = TelemetryExporter("scheduler", None, m, instance_id="s0", full_every=100)
+    m.jobs_dispatched.inc(topic="a")
+    m.jobs_dispatched.inc(topic="b")
+    first = exp.build_snapshot()  # seq 0 → full
+    assert first.full
+    assert len(first.metrics["counters"]["cordum_jobs_dispatched_total"]) == 2
+
+    m.jobs_dispatched.inc(topic="a")  # only series "a" moves
+    second = exp.build_snapshot()
+    assert not second.full
+    changed = second.metrics["counters"]["cordum_jobs_dispatched_total"]
+    assert changed == [[{"topic": "a"}, 2.0]]
+
+    third = exp.build_snapshot()  # nothing moved → family absent
+    assert "cordum_jobs_dispatched_total" not in third.metrics["counters"]
+
+
+def test_exporter_periodic_full_snapshot():
+    m = Metrics()
+    exp = TelemetryExporter("w", None, m, full_every=3)
+    m.workers_live.set(1.0)
+    assert exp.build_snapshot().full  # seq 0
+    assert not exp.build_snapshot().full
+    assert not exp.build_snapshot().full
+    snap = exp.build_snapshot()  # seq 3
+    assert snap.full
+    assert snap.metrics["gauges"]["cordum_workers_live"] == [[{}, 1.0]]
+
+
+# ---------------------------------------------------------------------------
+# cross-process aggregation correctness (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _drive(agg: FleetAggregator, exporters: list[TelemetryExporter]):
+    for exp in exporters:
+        agg.ingest(exp.build_snapshot())
+
+
+def test_fleet_counter_equals_per_process_sum_randomized():
+    """Randomized multi-process streams: after arbitrary interleavings of
+    increments and snapshot publishes — including a process restart mid-
+    stream — the fleet-merged counter equals the true sum of every
+    increment ever made."""
+    rng = random.Random(1234)
+    agg = FleetAggregator(None)
+    registries = [Metrics() for _ in range(3)]
+    exporters = [
+        TelemetryExporter("scheduler", None, m, instance_id=f"s{i}")
+        for i, m in enumerate(registries)
+    ]
+    topics = ["a", "b", "c"]
+    truth: dict[str, float] = {t: 0.0 for t in topics}
+    for step in range(200):
+        i = rng.randrange(3)
+        t = rng.choice(topics)
+        amt = rng.randint(1, 5)
+        registries[i].jobs_dispatched.inc(amount=float(amt), topic=t)
+        truth[t] += amt
+        if rng.random() < 0.3:
+            agg.ingest(exporters[i].build_snapshot())
+        if step == 120:
+            # process 1 restarts mid-stream: new registry, new exporter
+            # epoch — its counters reset to zero.  The aggregator must keep
+            # the dead epoch's contribution (counter-reset detection).
+            registries[1] = Metrics()
+            exporters[1] = TelemetryExporter(
+                "scheduler", None, registries[1], instance_id="s1"
+            )
+            # distinct epoch even at equal wall-clock microseconds
+            exporters[1].started_at_us = exporters[0].started_at_us - 1
+    _drive(agg, exporters)
+    merged = agg.merged_counters()["cordum_jobs_dispatched_total"]
+    for t in topics:
+        assert merged[(("topic", t),)] == truth[t], t
+    assert agg.counter_total("cordum_jobs_dispatched_total") == sum(truth.values())
+
+
+def test_fleet_histogram_quantiles_equal_union_stream():
+    """Merged-histogram quantiles == quantiles of the union stream: a
+    reference Histogram observing every sample from every process must
+    agree with the aggregator's merged buckets at every quantile."""
+    rng = random.Random(99)
+    agg = FleetAggregator(None)
+    registries = [Metrics() for _ in range(4)]
+    exporters = [
+        TelemetryExporter("scheduler", None, m, instance_id=f"p{i}")
+        for i, m in enumerate(registries)
+    ]
+    reference = Histogram("ref")  # same default buckets as e2e_latency
+    for _ in range(600):
+        i = rng.randrange(4)
+        v = rng.expovariate(8.0)
+        registries[i].e2e_latency.observe(v, job_class="BATCH")
+        reference.observe(v)
+        if rng.random() < 0.1:
+            agg.ingest(exporters[i].build_snapshot())
+    _drive(agg, exporters)
+    buckets, fams = agg.merged_histograms()["cordum_job_e2e_seconds"]
+    merged = fams[(("job_class", "BATCH"),)]
+    assert merged["total"] == 600
+    from cordum_tpu.obs.fleet import quantile_from_buckets
+
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert quantile_from_buckets(
+            buckets, merged["counts"], merged["total"], q
+        ) == reference.quantile(q), q
+
+
+def test_restart_folds_histograms_too():
+    agg = FleetAggregator(None)
+    m = Metrics()
+    exp = TelemetryExporter("w", None, m, instance_id="w0")
+    m.e2e_latency.observe(0.01)
+    agg.ingest(exp.build_snapshot())
+    # restart: fresh registry, new epoch, two more observations
+    m2 = Metrics()
+    exp2 = TelemetryExporter("w", None, m2, instance_id="w0")
+    exp2.started_at_us = exp.started_at_us + 7
+    m2.e2e_latency.observe(0.02)
+    m2.e2e_latency.observe(0.03)
+    agg.ingest(exp2.build_snapshot())
+    _, fams = agg.merged_histograms()["cordum_job_e2e_seconds"]
+    assert fams[()]["total"] == 3
+
+
+def test_gauges_keep_their_instance_in_fleet_render():
+    agg = FleetAggregator(None)
+    for i in range(2):
+        m = Metrics()
+        m.workers_live.set(4.0)
+        agg.ingest(TelemetryExporter(
+            "scheduler", None, m, instance_id=f"s{i}").build_snapshot())
+    text = agg.render()
+    # NOT summed to 8: one line per instance
+    assert 'cordum_workers_live{instance="s0"} 4.0' in text
+    assert 'cordum_workers_live{instance="s1"} 4.0' in text
+    parsed = _parse_exposition(text)
+    assert parsed["cordum_fleet_instances"][frozenset({("service", "scheduler")})] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over the loopback bus + SLO burn rates
+# ---------------------------------------------------------------------------
+
+
+async def test_exporters_to_aggregator_over_bus():
+    bus = LoopbackBus()
+    agg = FleetAggregator(bus, metrics=Metrics(), fine_step_s=0.02)
+    await agg.start()
+    m = Metrics()
+    exp = TelemetryExporter(
+        "worker", bus, m, instance_id="w1", interval_s=0.02,
+        health_fn=lambda: {"role": "worker", "active_jobs": 2},
+    )
+    m.jobs_by_class.inc(job_class="BATCH", status="SUCCEEDED")
+    assert await exp.publish_once()
+    await bus.drain()
+    agg.sample()
+    doc = agg.fleet_doc()
+    assert doc["healthy_services"] == 1
+    svc = doc["services"][0]
+    assert svc["service"] == "worker" and svc["instance"] == "w1"
+    assert svc["role"] == "worker" and svc["active_jobs"] == 2
+    assert svc["healthy"]
+    await agg.stop()
+    await bus.close()
+
+
+async def test_exporter_skips_when_nobody_listens():
+    bus = LoopbackBus()
+    m = Metrics()
+    exp = TelemetryExporter("worker", bus, m, instance_id="w1")
+    assert not await exp.publish_once()  # no aggregator → no packet built
+    assert m.telemetry_snapshots.total() == 0
+
+
+def test_slo_burn_rates_and_states():
+    agg = FleetAggregator(None)
+    agg.sample()  # zero baseline
+    m = Metrics()
+    exp = TelemetryExporter("scheduler", None, m, instance_id="s0")
+    # 10 INTERACTIVE jobs: 4 above the 100 ms objective, 1 FAILED
+    for v in (0.01, 0.02, 0.03, 0.04, 0.05, 0.06, 0.3, 0.4, 0.5, 0.6):
+        m.e2e_latency.observe(v, job_class="INTERACTIVE")
+    for status in ["SUCCEEDED"] * 9 + ["FAILED"]:
+        m.jobs_by_class.inc(job_class="INTERACTIVE", status=status)
+    agg.ingest(exp.build_snapshot())
+    gauge_reg = Metrics()
+    tracker = SLOTracker.from_config({
+        "interactive": {
+            "job_class": "INTERACTIVE", "latency_ms": 100,
+            "latency_target": 0.9, "availability_target": 0.99,
+        },
+        "quiet": {"job_class": "CRITICAL", "latency_ms": 50},
+    }, metrics=gauge_reg)
+    states = tracker.evaluate(agg)
+    inter = next(s for s in states if s["name"] == "interactive")
+    w5 = inter["windows"]["5m"]
+    # latency: 4/10 over → error fraction 0.4, budget 0.1 → burn 4.0
+    assert w5["latency_error_fraction"] == 0.4
+    assert w5["latency_burn_rate"] == 4.0
+    # availability: 1/10 failed → 0.1 error over 0.01 budget → burn 10.0
+    assert w5["availability_burn_rate"] == 10.0
+    assert w5["burn_rate"] == 10.0
+    assert inter["state"] == "warn"
+    assert gauge_reg.slo_burn_rate.value(slo="interactive", window="5m") == 10.0
+    quiet = next(s for s in states if s["name"] == "quiet")
+    assert quiet["state"] == "ok" and quiet["windows"]["5m"]["total"] == 0
+
+
+def test_slo_page_state_needs_both_windows_hot():
+    """The page state requires BOTH the 5 m and 1 h windows burning (the
+    multi-window rule); a fleet burning 100% of a tight budget trips it."""
+    agg = FleetAggregator(None)
+    agg.sample()
+    m = Metrics()
+    exp = TelemetryExporter("scheduler", None, m, instance_id="s0")
+    for _ in range(50):
+        m.e2e_latency.observe(5.0, job_class="INTERACTIVE")  # all way over
+    agg.ingest(exp.build_snapshot())
+    tracker = SLOTracker.from_config({
+        "i": {"job_class": "INTERACTIVE", "latency_ms": 100,
+              "latency_target": 0.99},
+    })
+    st = tracker.evaluate(agg)[0]
+    assert st["windows"]["5m"]["burn_rate"] == 100.0
+    assert st["state"] == "page"
+
+
+def test_pools_yaml_slo_stanza_schema():
+    cfg = parse_pool_config({
+        "topics": {"job.x": "p"}, "pools": {"p": {}},
+        "slo": {"inter": {"job_class": "INTERACTIVE", "latency_ms": 250,
+                          "latency_target": 0.99}},
+    })
+    assert cfg.slo["inter"]["latency_ms"] == 250
+    try:
+        parse_pool_config({
+            "pools": {"p": {}},
+            "slo": {"bad": {"latency_target": 0.99}},  # latency_ms required
+        })
+    except ConfigError as e:
+        assert "latency_ms" in str(e)
+    else:
+        raise AssertionError("schema accepted an slo entry without latency_ms")
+
+
+# ---------------------------------------------------------------------------
+# runtime profiler
+# ---------------------------------------------------------------------------
+
+
+async def test_profiler_observes_lag_and_slow_ticks():
+    m = Metrics()
+    prof = RuntimeProfiler(m, service="test", tick_s=0.02, slow_tick_s=0.05)
+    await prof.start()
+    await asyncio.sleep(0.06)  # a couple of clean ticks
+
+    async def hog():
+        time.sleep(0.12)  # deliberately block the loop (the stall under test)
+
+    await asyncio.ensure_future(hog())
+    await asyncio.sleep(0.08)
+    await prof.stop()
+    assert m.eventloop_lag._totals, "no lag samples recorded"
+    assert m.slow_ticks.total() >= 1
+    assert prof.last_slow_tick is not None
+    assert prof.last_slow_tick["lag_s"] >= 0.05
+    assert "last_slow_tick_lag_s" in prof.health()
+
+
+async def test_profiler_counts_gc_pauses():
+    m = Metrics()
+    prof = RuntimeProfiler(m, service="test", tick_s=5.0)
+    await prof.start()
+    gc.collect()
+    await prof.stop()
+    assert m.gc_pauses.total() >= 1
+    total = sum(m.gc_pause_seconds._totals.values())
+    assert total >= 1
+    gc.collect()
+    after = m.gc_pauses.total()
+    gc.collect()
+    assert m.gc_pauses.total() == after  # callback removed on stop
+
+
+# ---------------------------------------------------------------------------
+# span-drop accounting (satellite)
+# ---------------------------------------------------------------------------
+
+
+async def test_collector_counts_per_trace_cap_drops():
+    kv, bus, m = MemoryKV(), LoopbackBus(), Metrics()
+    col = SpanCollector(kv, bus, metrics=m, max_spans_per_trace=4)
+    for i in range(6):
+        await col.add(Span(span_id=f"s{i}", trace_id="t1", name="x",
+                           service="w", start_us=now_us(), end_us=now_us()))
+    assert m.spans_dropped.value(reason="per_trace_cap") == 2.0
+    assert len(await col.spans("t1")) == 4
+
+
+async def test_collector_counts_eviction_drops():
+    kv, bus, m = MemoryKV(), LoopbackBus(), Metrics()
+    col = SpanCollector(kv, bus, metrics=m, max_traces=2)
+    for t in ("t1", "t2", "t3"):
+        await col.add(Span(span_id=f"s-{t}", trace_id=t, name="x",
+                           service="w", start_us=now_us(), end_us=now_us()))
+    assert m.spans_dropped.value(reason="trace_evicted") == 1.0
+
+
+async def test_collector_recent_lists_newest_first():
+    kv, bus = MemoryKV(), LoopbackBus()
+    col = SpanCollector(kv, bus)
+    t0 = now_us()
+    for i, tid in enumerate(("t1", "t2")):
+        await col.add(Span(span_id=f"root-{tid}", trace_id=tid, name="submit",
+                           service="gateway", start_us=t0 + i,
+                           end_us=t0 + i + 5000))
+        await col.add(Span(span_id=f"leaf-{tid}", trace_id=tid,
+                           parent_span_id=f"root-{tid}", name="execute",
+                           service="worker", start_us=t0 + i + 1000,
+                           end_us=t0 + i + 4000))
+    recent = await col.recent(10)
+    assert [t["trace_id"] for t in recent] == ["t2", "t1"]
+    assert recent[0]["root"] == "submit"
+    assert recent[0]["span_count"] == 2
+    assert recent[0]["services"] == ["gateway", "worker"]
+    assert recent[0]["duration_ms"] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# gateway surfaces
+# ---------------------------------------------------------------------------
+
+
+class _FleetStack:
+    """Gateway with telemetry enabled + a fake scheduler exporter on the
+    same loopback bus, behind a live HTTP server."""
+
+    def __init__(self):
+        self.kv = MemoryKV()
+        self.bus = LoopbackBus()
+        wf_store = WorkflowStore(self.kv)
+        mem = MemoryStore(self.kv)
+        self.gw = Gateway(
+            kv=self.kv, bus=self.bus, job_store=JobStore(self.kv), mem=mem,
+            kernel=SafetyKernel(policy_doc=POLICY), wf_store=wf_store,
+            wf_engine=WorkflowEngine(store=wf_store, bus=self.bus, mem=mem),
+            schemas=SchemaRegistry(self.kv),
+            auth=BasicAuthProvider(["user-key"]),
+            slo_config={"batch": {"job_class": "BATCH", "latency_ms": 1000}},
+        )
+        self.sched_metrics = Metrics()
+        self.sched_exporter = TelemetryExporter(
+            "scheduler", self.bus, self.sched_metrics, instance_id="sched-0",
+            health_fn=lambda: {"role": "scheduler", "shard_index": 0,
+                               "shard_count": 1, "jobs_scheduled":
+                               self.sched_metrics.jobs_dispatched.total()},
+        )
+        self.client = None
+
+    async def __aenter__(self):
+        await self.gw.fleet.start()
+        await self.gw.telemetry.start()
+        await self.gw.span_collector.start()
+        self.client = TestClient(TestServer(self.gw.app))
+        await self.client.start_server()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.client.close()
+        await self.gw.span_collector.stop()
+        await self.gw.telemetry.stop()
+        await self.gw.fleet.stop()
+        await self.bus.close()
+
+    def h(self):
+        return {"X-Api-Key": "user-key"}
+
+
+async def test_gateway_fleet_endpoint_and_fleet_metrics():
+    async with _FleetStack() as s:
+        s.sched_metrics.jobs_dispatched.inc(amount=3, topic="job.x")
+        await s.sched_exporter.publish_once()
+        await s.gw.telemetry.publish_once()
+        await s.bus.drain()
+        s.gw.fleet.sample()
+
+        r = await s.client.get("/api/v1/fleet", headers=s.h())
+        assert r.status == 200
+        doc = await r.json()
+        services = {sv["service"] for sv in doc["services"]}
+        assert {"scheduler", "gateway"} <= services
+        assert doc["healthy_services"] >= 2
+        assert doc["fleet"]["jobs_dispatched_total"] == 3.0
+        # fleet counter == sum of the per-service beacon values
+        beacon_sum = sum(sv.get("jobs_scheduled", 0) for sv in doc["services"])
+        assert doc["fleet"]["jobs_dispatched_total"] == beacon_sum
+        assert doc["slo"][0]["name"] == "batch"
+        assert "burn_rate" in doc["slo"][0]["windows"]["5m"]
+
+        r = await s.client.get("/metrics?scope=fleet", headers=s.h())
+        parsed = _parse_exposition(await r.text())
+        assert parsed["cordum_jobs_dispatched_total"][
+            frozenset({("topic", "job.x")})] == 3.0
+
+        # the plain scope still renders the gateway's own registry
+        r = await s.client.get("/metrics", headers=s.h())
+        assert "cordum_http_requests_total" in await r.text()
+
+        # the CLI table renders from the same doc
+        table = render_fleet_table(doc)
+        assert "scheduler" in table and "sched-0" in table
+        assert "slo batch" in table
+
+
+async def test_gateway_traces_listing():
+    async with _FleetStack() as s:
+        t0 = now_us()
+        await s.gw.span_collector.add(Span(
+            span_id="r1", trace_id="tr-1", name="submit", service="gateway",
+            start_us=t0, end_us=t0 + 1000,
+        ))
+        r = await s.client.get("/api/v1/traces?last=5", headers=s.h())
+        assert r.status == 200
+        doc = await r.json()
+        assert doc["traces"][0]["trace_id"] == "tr-1"
+        assert doc["traces"][0]["root"] == "submit"
+
+
+# ---------------------------------------------------------------------------
+# wire round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_snapshot_wire_round_trip():
+    m = Metrics()
+    m.jobs_dispatched.inc(topic="t")
+    m.e2e_latency.observe(0.1, job_class="BATCH")
+    snap = TelemetryExporter("scheduler", None, m,
+                             instance_id="s0").build_snapshot()
+    pkt = BusPacket.wrap(snap, sender_id="s0")
+    decoded = BusPacket.from_wire(pkt.to_wire())
+    assert subj.telemetry_subject("scheduler") == "sys.telemetry.scheduler"
+    got = decoded.telemetry
+    assert got.service == "scheduler" and got.instance == "s0"
+    assert got.metrics["counters"]["cordum_jobs_dispatched_total"] == [
+        [{"topic": "t"}, 1.0]
+    ]
+    agg = FleetAggregator(None)
+    agg.ingest(got)
+    assert agg.counter_total("cordum_jobs_dispatched_total") == 1.0
+
+
+def test_telemetry_subject_not_durable():
+    assert not subj.is_durable_subject(subj.telemetry_subject("worker"))

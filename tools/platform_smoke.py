@@ -84,6 +84,8 @@ def spawn_stack(logdir: str) -> list[subprocess.Popen]:
          {"GATEWAY_HTTP_ADDR": f"127.0.0.1:{GATEWAY_PORT}",
           "CORDUM_API_KEYS": "smoke-key",
           "CORDUM_ADMIN_KEYS": "smoke-admin",
+          # the gateway reads the slo: stanza for the fleet SLO tracker
+          "POOL_CONFIG_PATH": os.path.join(logdir, "pools.yaml"),
           "SAFETY_POLICY_PATH": os.path.join(logdir, "safety.yaml")}),
         ("worker", "cordum_tpu.cmd.worker",
          {"WORKER_ID": "smoke-w1", "WORKER_POOL": "tpu",
@@ -101,6 +103,10 @@ def spawn_stack(logdir: str) -> list[subprocess.Popen]:
         f.write(
             "topics:\n  job.default: tpu\n  job.hello-pack.echo: tpu\n  job.tpu.>: tpu\n"
             "pools:\n  tpu:\n    requires: []\n"
+            # SLO objective for the fleet telemetry step: every smoke job
+            # submits at the default BATCH class
+            "slo:\n  batch:\n    job_class: BATCH\n    latency_ms: 5000\n"
+            "    latency_target: 0.95\n"
         )
     with open(os.path.join(logdir, "timeouts.yaml"), "w") as f:
         f.write("reconciler:\n  dispatch_timeout_seconds: 60\n"
@@ -287,6 +293,14 @@ def main() -> int:
                 if ln.startswith("cordum_stage_seconds_count") and not ln.rstrip().endswith(" 0")
             ]
             assert stage_counts, "no non-zero cordum_stage_seconds in /metrics"
+            # retention caps must not have silently truncated any trace
+            # (cordum_spans_dropped_total stays 0 through the whole run)
+            dropped = [
+                ln for ln in metrics_text.splitlines()
+                if ln.startswith("cordum_spans_dropped_total")
+                and not ln.rstrip().endswith(" 0") and not ln.rstrip().endswith(" 0.0")
+            ]
+            assert not dropped, f"spans dropped during smoke: {dropped}"
             cli = subprocess.run(
                 [sys.executable, "-m", "cordum_tpu.cli", "trace", trace_id],
                 capture_output=True, text=True, timeout=30, cwd=REPO,
@@ -344,6 +358,62 @@ def main() -> int:
             assert best >= 8, f"largest flushed batch was {best}, wanted >= 8"
             log(f"7. bulk fan-out of {n_fan} embed jobs coalesced "
                 f"(largest flushed batch {best})")
+
+            # 8. fleet telemetry plane: /api/v1/fleet must show every
+            # process's health beacon (gateway, 2 scheduler shards, statebus
+            # partitions, worker, kernel, wf-engine), a fleet-wide scheduled
+            # counter matching the per-shard beacon sum, a non-zero job rate
+            # over the run, and an SLO burn rate for the configured class —
+            # and `cordumctl top` must render it
+            want_services = {"gateway", "scheduler", "statebus", "worker"}
+            fleet = {}
+            t0 = time.time()
+            while time.time() - t0 < 45:
+                fleet = c.get("/api/v1/fleet").json()
+                healthy = {s["service"] for s in fleet.get("services", [])
+                           if s.get("healthy")}
+                if (want_services <= healthy
+                        and fleet.get("healthy_services", 0) >= 4
+                        and fleet["fleet"].get("jobs_dispatched_total", 0) > 0):
+                    break
+                time.sleep(1.0)
+            healthy = {s["service"] for s in fleet["services"] if s["healthy"]}
+            assert want_services <= healthy, f"missing beacons: {healthy}"
+            assert fleet["healthy_services"] >= 4, fleet["counts"]
+            shards = [s for s in fleet["services"]
+                      if s["service"] == "scheduler" and s["healthy"]]
+            assert len(shards) == 2, f"expected 2 scheduler shards: {shards}"
+            assert {s.get("shard_index") for s in shards} == {0, 1}, shards
+            parts = [s for s in fleet["services"]
+                     if s["service"] == "statebus" and s["healthy"]]
+            assert {p.get("partition") for p in parts} == {0, 1}, parts
+            # fleet-wide scheduled counter == sum of the per-shard beacons
+            beacon_sum = sum(s.get("jobs_scheduled", 0) for s in shards)
+            assert fleet["fleet"]["jobs_dispatched_total"] == beacon_sum > 0, (
+                fleet["fleet"], shards)
+            # every earlier step ran jobs: the run-window rate is non-zero
+            assert fleet["fleet"]["completed_5m"] > 0, fleet["fleet"]
+            # the SLO tracker reports a burn rate for the configured class
+            slo = {s["name"]: s for s in fleet.get("slo", [])}
+            assert "batch" in slo, fleet.get("slo")
+            w5 = slo["batch"]["windows"]["5m"]
+            assert w5["total"] > 0 and w5["burn_rate"] >= 0.0, w5
+            assert slo["batch"]["state"] in ("ok", "warn", "page"), slo
+            assert fleet["fleet"]["spans_dropped_total"] == 0, fleet["fleet"]
+            top = subprocess.run(
+                [sys.executable, "-m", "cordum_tpu.cli", "top", "--once"],
+                capture_output=True, text=True, timeout=30, cwd=REPO,
+                env={**os.environ, "CORDUM_API_URL": API,
+                     "CORDUM_API_KEY": H_USER["X-Api-Key"],
+                     "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")},
+            )
+            assert top.returncode == 0, top.stderr
+            for needle in ("scheduler", "statebus", "worker", "slo batch"):
+                assert needle in top.stdout, (needle, top.stdout)
+            log(f"8. fleet telemetry: {fleet['healthy_services']} healthy beacons "
+                f"({sorted(healthy)}), fleet scheduled={beacon_sum}, slo "
+                f"burn5m={w5['burn_rate']} ({slo['batch']['state']}); "
+                "cordumctl top renders")
 
         log("PASS")
         return 0
