@@ -91,7 +91,8 @@ def _make_stack():
 
 
 async def bench_scheduler(telemetry: bool = False,
-                          n_jobs: Optional[int] = None) -> dict:
+                          n_jobs: Optional[int] = None,
+                          profiling: bool = False) -> dict:
     """Burst throughput: N_JOBS submitted as fast as possible.
 
     ``telemetry=True`` attaches the full fleet telemetry plane (ISSUE 9) to
@@ -99,14 +100,27 @@ async def bench_scheduler(telemetry: bool = False,
     at an aggressive 0.25 s cadence plus the gateway-role FleetAggregator +
     SLOTracker — so interleaved plain/instrumented pairs measure the export
     overhead, and the post-run fleet snapshot is checked for correctness
-    (merged counter == the engine registry, SLO burn rate present)."""
+    (merged counter == the engine registry, SLO burn rate present).
+
+    ``profiling=True`` additionally turns on the ISSUE 10 capacity
+    observatory instrumentation: histogram exemplar capture plus a
+    per-job CapacityProfiler observation on the worker leg whose block
+    rides the telemetry beacon — the instrumented half of the
+    ``profiling_overhead_pct`` pairs.  ``profiling=False`` disables
+    exemplar capture globally so the plain half really is plain."""
+    from cordum_tpu.infra import metrics as metrics_mod
     from cordum_tpu.protocol import subjects as subj
     from cordum_tpu.protocol.types import BusPacket, JobRequest, JobResult
 
+    metrics_mod.set_exemplars_enabled(profiling)
     kv, bus, js, eng = _make_stack()
     await eng.start()
 
-    agg = tracker = exporter = None
+    agg = tracker = exporter = capacity = None
+    if profiling:
+        from cordum_tpu.obs.capacity import CapacityProfiler
+
+        capacity = CapacityProfiler("cpu")
     if telemetry:
         from cordum_tpu.infra.metrics import Metrics
         from cordum_tpu.obs import FleetAggregator, SLOTracker, TelemetryExporter
@@ -116,16 +130,24 @@ async def bench_scheduler(telemetry: bool = False,
         tracker = SLOTracker.from_config(
             {"batch": {"job_class": "BATCH", "latency_ms": 1000,
                        "latency_target": 0.95}})
+
+        def health() -> dict:
+            doc = {"role": "scheduler",
+                   "jobs_scheduled": eng.metrics.jobs_dispatched.total()}
+            if capacity is not None:
+                doc["capacity"] = capacity.snapshot()
+            return doc
+
         exporter = TelemetryExporter(
             "scheduler", bus, eng.metrics, instance_id="bench-sched-0",
-            interval_s=0.25,
-            health_fn=lambda: {"role": "scheduler",
-                               "jobs_scheduled": eng.metrics.jobs_dispatched.total()},
+            interval_s=0.25, health_fn=health,
         )
         await exporter.start()
 
     async def worker_handler(subject, pkt):
         req = pkt.job_request
+        if capacity is not None:
+            t_h = time.perf_counter()
         await bus.publish(
             subj.RESULT,
             BusPacket.wrap(
@@ -133,6 +155,9 @@ async def bench_scheduler(telemetry: bool = False,
                 trace_id=pkt.trace_id, sender_id="bench-w", span_id=pkt.span_id,
             ),
         )
+        if capacity is not None:
+            capacity.observe("bench", device_s=time.perf_counter() - t_h,
+                             bucket="-", items=1)
 
     await bus.subscribe(subj.direct_subject("bench-w"), worker_handler, queue="w")
 
@@ -178,10 +203,25 @@ async def bench_scheduler(telemetry: bool = False,
         out["fleet_services"] = doc["healthy_services"]
         out["slo_burn_rate_5m"] = w5.get("burn_rate", -1.0)
         out["slo_state"] = slo.get("state", "")
+        if capacity is not None:
+            # capacity observatory correctness: the beacon-shipped profile
+            # must come back out of the aggregator as a fresh non-zero
+            # throughput-matrix row for the bench op
+            cap = agg.capacity_doc()
+            rows = [r for r in cap["matrix"]
+                    if r["op"] == "bench" and not r["stale"]]
+            out["capacity_matrix_ok"] = float(
+                bool(rows)
+                and rows[0]["items_per_s"] > 0
+                and rows[0]["n"] >= jobs_target
+                and cap["ops"].get("bench", 0.0) > 0
+            )
+            out["capacity_ops"] = len(cap["ops"])
         await exporter.stop()
         await agg.stop()
     await eng.stop()
     await bus.close()
+    metrics_mod.set_exemplars_enabled(True)  # process-global: don't leak
     return out
 
 
@@ -460,6 +500,42 @@ def bench_telemetry(pairs: int = 5) -> dict:
         "fleet_services": last.get("fleet_services", 0),
         "slo_burn_rate_5m": last.get("slo_burn_rate_5m", -1.0),
         "slo_state": last.get("slo_state", ""),
+    }
+
+
+def bench_profiling(pairs: int = 5) -> dict:
+    """Capacity-observatory instrumentation cost + matrix correctness
+    (ISSUE 10), same harness as ``bench_telemetry``.
+
+    Interleaved (telemetry, telemetry+profiling) scheduler-burst pairs at
+    the full telemetry job count — both halves carry the exporter/
+    aggregator, so the ratio isolates the PROFILER itself (per-job
+    CapacityProfiler observation + histogram exemplar capture + the
+    capacity block riding each beacon) from the already-gated export cost.
+    Reports the MEDIAN overhead pct (ceiling-gated ≤5% in bench_floor.json)
+    and ``capacity_matrix_ok``: the instrumented run's aggregator must
+    reproduce the bench op as a fresh non-zero throughput-matrix row.
+    """
+    import statistics
+
+    n = TELEMETRY_JOBS
+    asyncio.run(bench_scheduler(telemetry=True, n_jobs=n, profiling=True))  # warmup
+    overheads = []
+    last = {}
+    for _ in range(pairs):
+        base = asyncio.run(bench_scheduler(telemetry=True, n_jobs=n))
+        instr = asyncio.run(
+            bench_scheduler(telemetry=True, n_jobs=n, profiling=True))
+        last = instr
+        if base["jobs_per_sec"]:
+            overheads.append(
+                100.0 * (1.0 - instr["jobs_per_sec"] / base["jobs_per_sec"]))
+    return {
+        "profiling_overhead_pct": round(
+            statistics.median(overheads), 1) if overheads else 100.0,
+        "profiling_overhead_runs": [round(o, 1) for o in overheads],
+        "capacity_matrix_ok": last.get("capacity_matrix_ok", 0.0),
+        "capacity_ops": last.get("capacity_ops", 0),
     }
 
 
@@ -1415,6 +1491,7 @@ def main() -> None:
     sb_perop = asyncio.run(bench_statebus(False, sb_jobs))
     sb_repl = bench_replication_overhead()
     tele = bench_telemetry()
+    capprof = bench_profiling()
     sharded = asyncio.run(bench_sharded(shards, SB_PARTITIONS, sh_jobs))
     sharded_single = asyncio.run(bench_sharded(1, 1, sh_jobs))
     sel = bench_selection()
@@ -1450,6 +1527,11 @@ def main() -> None:
         # (merged counter == engine registry, SLO burn rate present);
         # overhead ceiling + fleet_snapshot_ok floor live in bench_floor.json
         **tele,
+        # capacity observatory (ISSUE 10): profiler cost over interleaved
+        # telemetry/telemetry+profiling pairs + the post-run throughput-
+        # matrix correctness flag (profiling_overhead_pct ceiling +
+        # capacity_matrix_ok floor live in bench_floor.json)
+        **capprof,
         # keyspace-sharded control plane (ISSUE 5): S scheduler-shard
         # processes over P statebus partition processes, vs the same
         # multi-process harness at 1×1

@@ -272,7 +272,19 @@ def cmd_trace(args) -> None:
 
 def cmd_traces(args) -> None:
     """List recent trace ids with root span, duration and service count —
-    the entry point into the waterfall when you don't already know an id."""
+    the entry point into the waterfall when you don't already know an id.
+    ``traces blame`` instead aggregates the newest N traces' critical paths
+    into per-stage blame shares (where does p99 go)."""
+    if args.action == "blame":
+        from .obs.assembler import render_blame
+
+        with _client() as c:
+            doc = _check(c.get(f"/api/v1/traces/analysis?last={args.last}"))
+        if args.json:
+            _print(doc)
+            return
+        print(render_blame(doc))
+        return
     with _client() as c:
         doc = _check(c.get(f"/api/v1/traces?last={args.last}"))
     traces = doc.get("traces") or []
@@ -325,6 +337,20 @@ def cmd_top(args) -> None:
                 time.sleep(args.interval)
             except KeyboardInterrupt:
                 return
+
+
+def cmd_capacity(args) -> None:
+    """The fleet's op × worker throughput matrix (GET /api/v1/capacity):
+    per-(op, bucket) items/s + decode tokens/s, device p50/p99, compile
+    counts, freshness — the capacity observatory's operator view."""
+    from .obs.capacity import render_capacity_table
+
+    with _client() as c:
+        doc = _check(c.get("/api/v1/capacity"))
+    if args.json:
+        _print(doc)
+        return
+    print(render_capacity_table(doc))
 
 
 def cmd_pack(args) -> None:
@@ -460,11 +486,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--width", type=int, default=48)
     sp.set_defaults(fn=cmd_trace)
 
-    sp = sub.add_parser("traces", help="list recent traces (newest first)")
+    sp = sub.add_parser("traces",
+                        help="list recent traces / critical-path blame")
+    sp.add_argument("action", nargs="?", choices=["list", "blame"],
+                    default="list",
+                    help="blame: per-stage critical-path blame shares over "
+                         "the newest traces (GET /api/v1/traces/analysis)")
     sp.add_argument("--last", type=int, default=20,
-                    help="how many recent traces to list")
+                    help="how many recent traces to list/analyze")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_traces)
+
+    sp = sub.add_parser(
+        "capacity",
+        help="fleet op x worker throughput matrix (GET /api/v1/capacity)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_capacity)
 
     sp = sub.add_parser(
         "top", help="live fleet telemetry table (GET /api/v1/fleet)")

@@ -65,6 +65,9 @@ async def main() -> None:
         max_concurrent_runs=_boot.env_int("MAX_CONCURRENT_RUNS", 0),
         scheduler_shards=cfg.scheduler_shards,
         slo_config=slo_config,
+        # tail-based trace retention: < 1.0 keeps every slower-than-p95
+        # trace and samples the fast rest (docs/OBSERVABILITY.md)
+        trace_keep_fraction=_boot.env_float("CORDUM_TRACE_KEEP_FRACTION", 1.0),
     )
     host, _, port = cfg.gateway_http_addr.partition(":")
     await gw.start(host or "127.0.0.1", int(port or 8081))

@@ -44,7 +44,7 @@ from ...infra.metrics import Metrics
 from ...infra.registry import WorkerRegistry
 from ...infra.schemareg import SchemaError, SchemaRegistry
 from ...infra.secrets import contains_secret_refs
-from ...obs.assembler import assemble
+from ...obs.assembler import aggregate_critical_paths, assemble
 from ...obs.collector import SpanCollector
 from ...obs.fleet import FleetAggregator
 from ...obs.profiler import RuntimeProfiler
@@ -111,6 +111,7 @@ class Gateway:
         scheduler_shards: int = 1,
         slo_config: Optional[dict] = None,
         telemetry: bool = True,
+        trace_keep_fraction: float = 1.0,
     ):
         self.kv = kv
         self.bus = bus
@@ -131,8 +132,14 @@ class Gateway:
         self.tracer = Tracer("gateway", bus)
         # the gateway hosts the deployment's span collector: it owns /metrics
         # (stage histograms land there) and serves the trace API from the
-        # same KV the collector writes
-        self.span_collector = SpanCollector(kv, bus, metrics=self.metrics)
+        # same KV the collector writes.  trace_keep_fraction < 1.0 turns on
+        # tail-based retention: every slower-than-rolling-p95 trace is kept,
+        # the fast rest is sampled (docs/OBSERVABILITY.md §Capacity
+        # observatory)
+        self.span_collector = SpanCollector(
+            kv, bus, metrics=self.metrics,
+            tail_keep_fraction=trace_keep_fraction,
+        )
         # ... and the fleet telemetry plane (ISSUE 9): the aggregator merges
         # every process's sys.telemetry.<service> snapshots into the fleet
         # view (/api/v1/fleet, /metrics?scope=fleet, cordumctl top); the SLO
@@ -234,8 +241,12 @@ class Gateway:
         r.add_post(f"{v1}/context/memory/{{memory_id}}", self.context_update)
         r.add_put(f"{v1}/context/chunks/{{memory_id}}", self.context_chunks)
         r.add_get(f"{v1}/traces", self.list_traces)
+        # literal route must register before the {trace_id} wildcard or
+        # "analysis" would be read as a trace id
+        r.add_get(f"{v1}/traces/analysis", self.traces_analysis)
         r.add_get(f"{v1}/traces/{{trace_id}}", self.get_trace)
         r.add_get(f"{v1}/fleet", self.get_fleet)
+        r.add_get(f"{v1}/capacity", self.get_capacity)
         r.add_get(f"{v1}/workers", self.get_workers)
         r.add_get(f"{v1}/status", self.get_status)
         r.add_get(f"{v1}/stream", self.ws_stream)
@@ -1321,6 +1332,24 @@ class Gateway:
         return web.json_response(
             {"traces": await self.span_collector.recent(n)}
         )
+
+    async def traces_analysis(self, request: web.Request) -> web.Response:
+        """``GET /api/v1/traces/analysis?last=N`` — cross-trace critical-path
+        blame over the newest N stored traces: per-stage blame shares
+        (summing to ~1.0) with p50/p99 of each stage's exclusive time, plus
+        the slowest trace ids as exemplars (`cordum traces blame`)."""
+        n = min(500, max(1, int(request.query.get("last", "100"))))
+        ids = await self.span_collector.recent_trace_ids(n)
+        docs = [
+            assemble(tid, await self.span_collector.spans(tid)) for tid in ids
+        ]
+        return web.json_response(aggregate_critical_paths(docs))
+
+    async def get_capacity(self, request: web.Request) -> web.Response:
+        """``GET /api/v1/capacity`` — the op × worker throughput matrix
+        folded from the workers' capacity beacons (`cordumctl capacity`;
+        the heterogeneity-aware strategy's read-only input)."""
+        return web.json_response(self.fleet.capacity_doc())
 
     async def get_metrics(self, request: web.Request) -> web.Response:
         # ?scope=fleet: the aggregator's fleet-merged exposition (counters/

@@ -725,8 +725,11 @@ class Engine:
             self.metrics.jobs_by_class.inc(job_class=klass, status=it.state.value)
             sub_us = int(it.snap.get("submitted_at_us", "0") or 0)
             if sub_us:
+                # the job's trace id rides as an exemplar so an e2e bucket
+                # spike resolves straight to a stored trace (ISSUE 10)
                 self.metrics.e2e_latency.observe(
-                    max(0.0, (now_us() - sub_us) / 1e6), job_class=klass
+                    max(0.0, (now_us() - sub_us) / 1e6),
+                    exemplar=it.snap.get("trace_id", ""), job_class=klass,
                 )
             if it.state in (JobState.FAILED, JobState.TIMEOUT):
                 req = await self.job_store.get_request(it.res.job_id)
@@ -1250,7 +1253,8 @@ class Engine:
         sub_us = int(snap.get("submitted_at_us", "0") or 0)
         if sub_us:
             self.metrics.e2e_latency.observe(
-                max(0.0, (now_us() - sub_us) / 1e6), job_class=klass
+                max(0.0, (now_us() - sub_us) / 1e6),
+                exemplar=snap.get("trace_id", ""), job_class=klass,
             )
         if state in (JobState.FAILED, JobState.TIMEOUT):
             req = await self.job_store.get_request(res.job_id)

@@ -7,15 +7,62 @@ handlers) while ``render()``/``quantile()`` run on the event loop, so every
 read takes the same lock the writers take and works on a snapshot — an
 unlocked read can see a histogram's bucket list mid-update and report
 totals that never existed.
+
+Two ISSUE 10 additions:
+
+* **Exemplars** — ``Histogram.observe(v, exemplar=trace_id)`` remembers the
+  last trace id that landed in each bucket (OpenMetrics-style), rendered as
+  ``name_bucket{le="..."} N # {trace_id="..."} value ts`` so a p99 spike in
+  ``cordum_job_e2e_seconds`` links straight to an offending trace.  When no
+  explicit exemplar is passed, the registered provider (the tracer's active
+  span context, wired by ``cordum_tpu.obs``) is consulted.
+* **Label-cardinality guard** — a family that sees more than
+  ``max_label_sets`` distinct label sets (default 1000, env
+  ``CORDUM_METRICS_MAX_LABEL_SETS``) logs once and folds further new sets
+  into one ``{overflow="true"}`` series instead of growing unbounded
+  (bucket keys derived from job ids would otherwise explode the telemetry
+  snapshots).
 """
 from __future__ import annotations
 
+import os
 import threading
-from typing import Optional
+from typing import Callable, Mapping, Optional
+
+from ..utils.ids import now_us
 
 _DEFAULT_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
+
+DEFAULT_MAX_LABEL_SETS = int(os.environ.get("CORDUM_METRICS_MAX_LABEL_SETS", "1000"))
+_OVERFLOW_KEY: tuple[tuple[str, str], ...] = (("overflow", "true"),)
+
+# ambient exemplar source: (trace_id, span_id) of the active span; set by
+# cordum_tpu.obs at import so metrics stays importable without the tracer
+_exemplar_provider: Optional[Callable[[], tuple[str, str]]] = None
+_exemplars_enabled = True
+
+
+def set_exemplar_provider(fn: Optional[Callable[[], tuple[str, str]]]) -> None:
+    global _exemplar_provider
+    _exemplar_provider = fn
+
+
+def set_exemplars_enabled(on: bool) -> None:
+    """Global exemplar kill-switch (bench overhead pairs toggle it)."""
+    global _exemplars_enabled
+    _exemplars_enabled = on
+
+
+def _log_overflow(name: str, limit: int) -> None:
+    from . import logging as logx  # lazy: keep the module import-light
+
+    logx.warn(
+        "metric family exceeded its label-set budget; folding new series "
+        "into {overflow=\"true\"}",
+        metric=name, max_label_sets=limit,
+    )
 
 
 def _escape_label_value(v: str) -> str:
@@ -33,6 +80,16 @@ def _fmt_labels(labels: dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
+def format_exemplar(ex: Optional[tuple[str, float, int]]) -> str:
+    """OpenMetrics-style exemplar suffix for one bucket line (`` # {trace_id=
+    "..."} value ts``); empty string when the bucket has none."""
+    if not ex:
+        return ""
+    tid, value, ts_us = ex
+    return (f' # {{trace_id="{_escape_label_value(tid)}"}} '
+            f"{value} {ts_us / 1e6:.3f}")
+
+
 def _fmt_le(bound: float) -> str:
     """Histogram ``le`` bound as a plain float literal (``repr()`` of an
     int-typed bucket rendered ``1`` vs ``1.0`` and float noise rendered as
@@ -44,15 +101,32 @@ def _fmt_le(bound: float) -> str:
 
 
 class Counter:
-    def __init__(self, name: str, help_: str = "") -> None:
+    def __init__(self, name: str, help_: str = "",
+                 max_label_sets: int = 0) -> None:
         self.name = name
         self.help = help_
+        self.max_label_sets = max_label_sets or DEFAULT_MAX_LABEL_SETS
+        self._overflowed = False
         self._values: dict[tuple[tuple[str, str], ...], float] = {}
         self._lock = threading.Lock()
+
+    def _guard_key(
+        self, key: tuple[tuple[str, str], ...],
+        existing: Mapping[tuple[tuple[str, str], ...], object],
+    ) -> tuple[tuple[str, str], ...]:
+        """Cardinality guard (call under ``_lock``): a NEW label set beyond
+        the family budget folds into the ``{overflow="true"}`` series."""
+        if key in existing or len(existing) < self.max_label_sets:
+            return key
+        if not self._overflowed:
+            self._overflowed = True
+            _log_overflow(self.name, self.max_label_sets)
+        return _OVERFLOW_KEY
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:
+            key = self._guard_key(key, self._values)
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels: str) -> float:
@@ -83,6 +157,7 @@ class Gauge(Counter):
     def set(self, value: float, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:
+            key = self._guard_key(key, self._values)
             self._values[key] = value
 
     def render(self) -> list[str]:
@@ -93,24 +168,57 @@ class Gauge(Counter):
 
 
 class Histogram:
-    def __init__(self, name: str, help_: str = "", buckets: tuple[float, ...] = _DEFAULT_BUCKETS) -> None:
+    def __init__(self, name: str, help_: str = "",
+                 buckets: tuple[float, ...] = _DEFAULT_BUCKETS,
+                 max_label_sets: int = 0) -> None:
         self.name = name
         self.help = help_
         self.buckets = buckets
+        self.max_label_sets = max_label_sets or DEFAULT_MAX_LABEL_SETS
+        self._overflowed = False
         self._counts: dict[tuple[tuple[str, str], ...], list[int]] = {}
         self._sums: dict[tuple[tuple[str, str], ...], float] = {}
         self._totals: dict[tuple[tuple[str, str], ...], int] = {}
+        # per-series exemplars: bucket index (len(buckets) = +Inf) → the last
+        # (trace_id, value, ts_us) observation that landed in that bucket
+        self._exemplars: dict[
+            tuple[tuple[str, str], ...], dict[int, tuple[str, float, int]]
+        ] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, **labels: str) -> None:
+    def _guard_key(
+        self, key: tuple[tuple[str, str], ...]
+    ) -> tuple[tuple[str, str], ...]:
+        if key in self._totals or len(self._totals) < self.max_label_sets:
+            return key
+        if not self._overflowed:
+            self._overflowed = True
+            _log_overflow(self.name, self.max_label_sets)
+        return _OVERFLOW_KEY
+
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels: str) -> None:
         key = tuple(sorted(labels.items()))
+        if exemplar is None and _exemplars_enabled and _exemplar_provider is not None:
+            try:
+                exemplar = _exemplar_provider()[0]
+            except Exception:  # noqa: BLE001 - exemplars must never fail the observe
+                exemplar = ""
         with self._lock:
+            key = self._guard_key(key)
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            idx = len(self.buckets)  # +Inf
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     counts[i] += 1
+                    if i < idx:
+                        idx = i
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if exemplar and _exemplars_enabled:
+                self._exemplars.setdefault(key, {})[idx] = (
+                    str(exemplar), value, now_us()
+                )
 
     def quantile(self, q: float, **labels: str) -> Optional[float]:
         """Approximate quantile from bucket boundaries (observability only)."""
@@ -133,18 +241,34 @@ class Histogram:
                 for key in sorted(self._totals)
             ]
 
+    def exemplar_snapshot(
+        self,
+    ) -> dict[tuple[tuple[str, str], ...], dict[int, tuple[str, float, int]]]:
+        """Per-series exemplar map snapshot (bucket index → (trace_id,
+        value, ts_us)) — the telemetry exporter ships it fleet-ward."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._exemplars.items()}
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         snap = self._snapshot()
+        exs = self.exemplar_snapshot()
         for key, counts, sum_, total in snap:
             labels = dict(key)
+            series_ex = exs.get(key) or {}
             for i, b in enumerate(self.buckets):
                 bl = dict(labels)
                 bl["le"] = _fmt_le(b)
-                out.append(f"{self.name}_bucket{_fmt_labels(bl)} {counts[i]}")
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(bl)} {counts[i]}"
+                    + format_exemplar(series_ex.get(i))
+                )
             bl = dict(labels)
             bl["le"] = "+Inf"
-            out.append(f"{self.name}_bucket{_fmt_labels(bl)} {total}")
+            out.append(
+                f"{self.name}_bucket{_fmt_labels(bl)} {total}"
+                + format_exemplar(series_ex.get(len(self.buckets)))
+            )
             out.append(f"{self.name}_sum{_fmt_labels(labels)} {sum_}")
             out.append(f"{self.name}_count{_fmt_labels(labels)} {total}")
         return out
@@ -437,6 +561,13 @@ class Metrics:
                         for key, counts, sum_, total in fam._snapshot()
                     ],
                 }
+                exs = fam.exemplar_snapshot()
+                if exs:
+                    # str bucket indices: msgpack/JSON-safe either way
+                    hists[fam.name]["exemplars"] = [
+                        [dict(key), {str(i): list(ex) for i, ex in m.items()}]
+                        for key, m in exs.items()
+                    ]
             elif isinstance(fam, Gauge):
                 gauges[fam.name] = [[dict(k), v] for k, v in fam._snapshot()]
             else:

@@ -24,29 +24,61 @@ Fleet telemetry plane (per-fleet, ISSUE 9):
 * :mod:`profiler` — event-loop lag sampler, slow-tick stack dumps with the
   active trace id, GC-pause counters.
 
+Capacity observatory (ISSUE 10):
+
+* :mod:`capacity` — per-worker online device profiles (device-time EWMA +
+  histogram, compile-vs-steady split, items/s, decode tokens/s, occupancy,
+  KV-page headroom) published as a delta-encoded ``capacity`` beacon block;
+  the aggregator folds them into the op × worker throughput matrix
+  (``GET /api/v1/capacity``, ``cordumctl capacity``).
+* tail-latency attribution — tail-based trace retention
+  (:class:`collector.TailSampler`), cross-trace critical-path blame
+  (:func:`assembler.aggregate_critical_paths`), and exemplars on
+  ``Histogram.observe`` (``GET /api/v1/traces/analysis``,
+  ``cordum traces blame``).
+
 See docs/OBSERVABILITY.md for the end-to-end story.
 """
 from __future__ import annotations
 
-from .assembler import assemble, render_waterfall
-from .collector import SpanCollector
+from ..infra import metrics as _metrics
+from .assembler import (
+    aggregate_critical_paths,
+    assemble,
+    critical_path_blame,
+    render_blame,
+    render_waterfall,
+)
+from .capacity import CapacityProfiler, render_capacity_table
+from .collector import SpanCollector, TailSampler
 from .fleet import FleetAggregator, render_fleet_table
 from .profiler import RuntimeProfiler
 from .slo import SLOObjective, SLOTracker
 from .telemetry import TelemetryExporter
 from .tracer import Tracer, current_trace_context, last_active_context
 
+# ambient exemplar source: any Histogram.observe without an explicit
+# exemplar picks up the active span's trace id (docs/OBSERVABILITY.md
+# §Capacity observatory)
+_metrics.set_exemplar_provider(current_trace_context)
+
 __all__ = [
+    "CapacityProfiler",
     "FleetAggregator",
     "RuntimeProfiler",
     "SLOObjective",
     "SLOTracker",
     "SpanCollector",
+    "TailSampler",
     "TelemetryExporter",
     "Tracer",
+    "aggregate_critical_paths",
     "assemble",
+    "critical_path_blame",
     "current_trace_context",
     "last_active_context",
+    "render_blame",
+    "render_capacity_table",
     "render_fleet_table",
     "render_waterfall",
 ]

@@ -127,6 +127,162 @@ def _critical_path(
 
 
 # ---------------------------------------------------------------------------
+# cross-trace critical-path aggregation (ISSUE 10: "where does p99 go")
+# ---------------------------------------------------------------------------
+
+UNTRACKED_STAGE = "(untracked)"
+
+
+def critical_path_blame(doc: dict[str, Any]) -> dict[str, int]:
+    """Per-stage **exclusive** µs along one :func:`assemble` doc's critical
+    path.
+
+    Each path span's self time is its duration minus its overlap with the
+    **union** of the deeper path spans (the time the trace actually spent
+    inside a descendant belongs to the descendant's stage — deeper wins, so
+    no microsecond is attributed twice even when an async child outlives its
+    parent); wall time the path covers but no span accounts for (queueing
+    between publishes, clock-skew holes) lands in ``"(untracked)"``.  The
+    returned µs sum to the trace's critical-path wall time (or the span-sum
+    when clock skew pushes the union past the wall window), so blame shares
+    over many traces sum to ~1.0."""
+    spans = {s["span_id"]: s for s in doc.get("spans") or []}
+    path = [spans[sid] for sid in doc.get("critical_path") or [] if sid in spans]
+    out: dict[str, int] = {}
+    covered = 0
+    for i, sp in enumerate(path):
+        self_us = _exclusive_us(sp, path[i + 1:])
+        out[sp["name"]] = out.get(sp["name"], 0) + self_us
+        covered += self_us
+    total = int(doc.get("critical_path_us") or 0)
+    if total > covered:
+        out[UNTRACKED_STAGE] = out.get(UNTRACKED_STAGE, 0) + (total - covered)
+    return out
+
+
+def _exclusive_us(sp: dict[str, Any], deeper: list[dict[str, Any]]) -> int:
+    """``sp``'s duration minus its overlap with the union of the ``deeper``
+    path spans' intervals (merged sweep; path lengths are small)."""
+    start, end = int(sp["start_us"]), int(sp["end_us"])
+    if end <= start:
+        return 0
+    windows = sorted(
+        (max(start, int(d["start_us"])), min(end, int(d["end_us"])))
+        for d in deeper
+    )
+    overlap = 0
+    cursor = start
+    for w0, w1 in windows:
+        w0 = max(w0, cursor)
+        if w1 > w0:
+            overlap += w1 - w0
+            cursor = w1
+    return max(0, (end - start) - overlap)
+
+
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def aggregate_critical_paths(
+    docs: list[dict[str, Any]], *, slowest: int = 5
+) -> dict[str, Any]:
+    """Merge many traces' ``critical_path`` results into per-stage blame.
+
+    Returns a JSON-safe doc::
+
+        {traces, critical_path_us_total,
+         stages: {name: {blame_share, total_us, count, p50_ms, p99_ms}},
+         slowest: [{trace_id, critical_path_us, total_us}, ...]}
+
+    ``blame_share`` is each stage's fraction of the summed critical-path
+    wall time — shares (including ``"(untracked)"``) sum to ~1.0, so the
+    table answers "where does the tail go" directly.  ``p50_ms``/``p99_ms``
+    are over the stage's per-trace exclusive times, so a stage that is
+    cheap usually but catastrophic at p99 stands out against its share.
+    """
+    stages: dict[str, dict[str, Any]] = {}
+    per_stage_ms: dict[str, list[float]] = {}
+    grand = 0
+    worst: list[tuple[int, str, int]] = []
+    n = 0
+    for doc in docs:
+        if not doc.get("critical_path"):
+            continue
+        blame = critical_path_blame(doc)
+        if not blame:
+            continue
+        n += 1
+        trace_total = max(int(doc.get("critical_path_us") or 0),
+                          sum(blame.values()))
+        grand += trace_total
+        for name, us in blame.items():
+            st = stages.setdefault(name, {"total_us": 0, "count": 0})
+            st["total_us"] += us
+            st["count"] += 1
+            per_stage_ms.setdefault(name, []).append(us / 1000.0)
+        worst.append((trace_total, str(doc.get("trace_id", "")),
+                      int(doc.get("total_us") or 0)))
+    for name, st in stages.items():
+        vals = sorted(per_stage_ms[name])
+        st["blame_share"] = round(st["total_us"] / grand, 4) if grand else 0.0
+        st["p50_ms"] = round(_quantile(vals, 0.50), 3)
+        st["p99_ms"] = round(_quantile(vals, 0.99), 3)
+    worst.sort(reverse=True)
+    return {
+        "traces": n,
+        "critical_path_us_total": grand,
+        "stages": dict(sorted(
+            stages.items(),
+            key=lambda kv: kv[1]["total_us"], reverse=True,
+        )),
+        # the slowest traces ARE the blame table's exemplars: each id
+        # resolves via GET /api/v1/traces/{id} to a full waterfall
+        "slowest": [
+            {"trace_id": tid, "critical_path_us": cp, "total_us": tot}
+            for cp, tid, tot in worst[:max(0, slowest)]
+        ],
+    }
+
+
+def render_blame(doc: dict[str, Any], width: int = 32) -> str:
+    """ASCII blame table for ``cordum traces blame`` from an
+    :func:`aggregate_critical_paths` document."""
+    n = doc.get("traces", 0)
+    total_ms = (doc.get("critical_path_us_total") or 0) / 1000.0
+    lines = [
+        f"critical-path blame over {n} trace(s)  "
+        f"(total critical-path time {total_ms:.2f}ms)"
+    ]
+    stages = doc.get("stages") or {}
+    if not stages:
+        return lines[0] + "\n(no traces with a critical path collected)"
+    name_w = max(len(s) for s in stages) + 2
+    lines.append(
+        f"{'stage'.ljust(name_w)}{'share':>7}  {'p50ms':>9}  {'p99ms':>9}  "
+        f"{'total_ms':>10}  {'n':>5}"
+    )
+    for name, st in stages.items():
+        share = float(st.get("blame_share", 0.0))
+        bar = "#" * max(0, int(share * width))
+        lines.append(
+            f"{name.ljust(name_w)}{share * 100:6.1f}%  "
+            f"{st.get('p50_ms', 0.0):9.3f}  {st.get('p99_ms', 0.0):9.3f}  "
+            f"{st.get('total_us', 0) / 1000.0:10.2f}  {st.get('count', 0):5d}  |{bar}"
+        )
+    slowest = doc.get("slowest") or []
+    if slowest:
+        lines.append("slowest traces: " + "  ".join(
+            f"{t['trace_id']}={t['critical_path_us'] / 1000.0:.2f}ms"
+            for t in slowest
+        ))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # ASCII waterfall (CLI `cordum trace <id>`)
 # ---------------------------------------------------------------------------
 

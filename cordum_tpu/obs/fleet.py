@@ -28,7 +28,7 @@ from typing import Any, Optional
 
 from ..infra import logging as logx
 from ..infra.bus import Bus, Subscription
-from ..infra.metrics import Metrics, _fmt_labels, _fmt_le
+from ..infra.metrics import Metrics, _fmt_labels, _fmt_le, format_exemplar
 from ..protocol import subjects as subj
 from ..protocol.types import BusPacket, TelemetrySnapshot
 from ..utils.ids import now_us
@@ -74,7 +74,7 @@ class _InstanceState:
     __slots__ = (
         "service", "instance", "started_at_us", "seq", "interval_s",
         "uptime_s", "health", "last_seen", "counters", "gauges", "hists",
-        "hist_buckets",
+        "hist_buckets", "hist_exemplars", "capacity_rows", "capacity_meta",
     )
 
     def __init__(self, service: str, instance: str) -> None:
@@ -92,6 +92,14 @@ class _InstanceState:
         # (family, labelkey) → {"base_*": folded, "counts"/"sum"/"total": last}
         self.hists: dict[tuple[str, LabelKey], dict[str, Any]] = {}
         self.hist_buckets: dict[str, list[float]] = {}
+        # (family, labelkey) → {bucket_idx(str): [trace_id, value, ts_us]}
+        self.hist_exemplars: dict[tuple[str, LabelKey], dict[str, list]] = {}
+        # capacity observatory (ISSUE 10): "op|bucket" → exported profile row,
+        # folded from the beacon's delta-encoded `capacity` block.  Rows are
+        # cumulative-per-epoch, so a restart clears them (fold_restart) and
+        # the fresh epoch's full block repopulates.
+        self.capacity_rows: dict[str, dict] = {}
+        self.capacity_meta: dict[str, Any] = {}
 
     def fold_restart(self) -> None:
         """The process restarted: its cumulative series reset to zero.
@@ -109,6 +117,10 @@ class _InstanceState:
             h["counts"] = [0] * len(h["counts"])
             h["sum"] = 0.0
             h["total"] = 0
+        # capacity profiles are rate views of the dead epoch's cumulative
+        # device time — a restarted worker starts a fresh profile, so stale
+        # rows must not linger in the matrix
+        self.capacity_rows.clear()
 
     def apply(self, snap: TelemetrySnapshot) -> None:
         if self.started_at_us and snap.started_at_us != self.started_at_us:
@@ -119,6 +131,9 @@ class _InstanceState:
         self.uptime_s = snap.uptime_s
         self.health = dict(snap.health or {})
         self.last_seen = time.monotonic()
+        cap = self.health.pop("capacity", None)
+        if isinstance(cap, dict):
+            self._fold_capacity(cap)
         doc = snap.metrics or {}
         for name, series in (doc.get("counters") or {}).items():
             for labels, value in series:
@@ -143,6 +158,24 @@ class _InstanceState:
                 h["counts"] = list(counts)
                 h["sum"] = float(sum_)
                 h["total"] = int(total)
+            for labels, exmap in fam.get("exemplars") or []:
+                k = (name, tuple(sorted(labels.items())))
+                cur = self.hist_exemplars.setdefault(k, {})
+                for idx, ex in (exmap or {}).items():
+                    cur[str(idx)] = list(ex)
+
+    def _fold_capacity(self, block: dict) -> None:
+        """Fold one beacon `capacity` block: rows carry cumulative values,
+        the delta only decides which rows rode this beacon, so folding is a
+        plain overwrite (a lost beacon self-heals on the next change)."""
+        self.capacity_meta = {
+            k: block.get(k)
+            for k in ("device_kind", "ts_us", "seq", "kv_pages", "occupancy")
+            if block.get(k) is not None
+        }
+        for key, row in (block.get("rows") or {}).items():
+            if isinstance(row, dict):
+                self.capacity_rows[str(key)] = dict(row)
 
     def counter_total(self, name: str) -> float:
         return sum(b + l for (n, _), (b, l) in self.counters.items() if n == name)
@@ -448,6 +481,72 @@ class FleetAggregator:
             doc["slo"] = slo_tracker.evaluate(self)
         return doc
 
+    def capacity_doc(self) -> dict:
+        """``GET /api/v1/capacity`` — the op × worker throughput matrix
+        folded from the workers' beacon ``capacity`` blocks (ISSUE 10).
+
+        Staleness handling: a row from an instance whose beacon is overdue
+        (the same ``healthy`` bound the fleet doc uses) is marked
+        ``stale: true`` and excluded from the per-op totals; an instance
+        silent past ``instance_evict_s`` is dropped entirely by the sampler.
+        This is the read-only input the heterogeneity-aware scheduling
+        strategy (ROADMAP item 2) consumes."""
+        now = time.monotonic()
+        workers: dict[str, dict] = {}
+        matrix: list[dict] = []
+        ops: dict[str, float] = {}
+        for inst in sorted(self._instances.values(),
+                           key=lambda i: (i.service, i.instance)):
+            if not inst.capacity_rows:
+                continue
+            fresh = self._healthy(inst, now)
+            age = round(now - inst.last_seen, 2)
+            meta = inst.capacity_meta
+            wdoc: dict[str, Any] = {
+                "service": inst.service,
+                "device_kind": meta.get("device_kind", ""),
+                "fresh": fresh,
+                "age_s": age,
+                "rows": len(inst.capacity_rows),
+            }
+            for extra in ("kv_pages", "occupancy"):
+                if meta.get(extra) is not None:
+                    wdoc[extra] = meta[extra]
+            workers[inst.instance] = wdoc
+            for key in sorted(inst.capacity_rows):
+                row = dict(inst.capacity_rows[key])
+                row["worker"] = inst.instance
+                row["device_kind"] = meta.get("device_kind", "")
+                row["stale"] = not fresh
+                row["age_s"] = age
+                matrix.append(row)
+                if fresh:
+                    op = str(row.get("op", ""))
+                    ops[op] = ops.get(op, 0.0) + float(row.get("items_per_s", 0.0))
+        return {
+            "ts_us": now_us(),
+            "workers": workers,
+            "matrix": matrix,
+            "ops": {op: round(v, 2) for op, v in sorted(ops.items())},
+        }
+
+    def _merged_exemplars(
+        self, name: str, lk: LabelKey
+    ) -> dict[int, tuple[str, float, int]]:
+        """Freshest exemplar per bucket across instances for one merged
+        histogram series (exemplars don't merge — the newest wins)."""
+        best: dict[int, tuple[str, float, int]] = {}
+        for inst in self._instances.values():
+            for idx, ex in (inst.hist_exemplars.get((name, lk)) or {}).items():
+                try:
+                    i = int(idx)
+                    tid, value, ts = str(ex[0]), float(ex[1]), int(ex[2])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if i not in best or ts > best[i][2]:
+                    best[i] = (tid, value, ts)
+        return best
+
     def _gauge_rollup(self) -> dict:
         repl_lag = 0.0
         sessions = 0.0
@@ -488,15 +587,43 @@ class FleetAggregator:
             lines.append(f"# TYPE {name} histogram")
             for lk, m in sorted(fams.items()):
                 labels = dict(lk)
+                exs = self._merged_exemplars(name, lk)
                 for i, b in enumerate(buckets):
                     bl = dict(labels)
                     bl["le"] = _fmt_le(b)
-                    lines.append(f"{name}_bucket{_fmt_labels(bl)} {m['counts'][i]}")
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(bl)} {m['counts'][i]}"
+                        + format_exemplar(exs.get(i))
+                    )
                 bl = dict(labels)
                 bl["le"] = "+Inf"
-                lines.append(f"{name}_bucket{_fmt_labels(bl)} {m['total']}")
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(bl)} {m['total']}"
+                    + format_exemplar(exs.get(len(buckets)))
+                )
                 lines.append(f"{name}_sum{_fmt_labels(labels)} {m['sum']}")
                 lines.append(f"{name}_count{_fmt_labels(labels)} {m['total']}")
+        # capacity observatory: the throughput matrix as fleet gauges, fresh
+        # rows only (GET /api/v1/capacity carries the stale-flagged view)
+        cap = self.capacity_doc()
+        cap_rows = [r for r in cap["matrix"] if not r.get("stale")]
+        if cap_rows:
+            lines.append("# TYPE cordum_capacity_items_per_sec gauge")
+            for r in cap_rows:
+                lines.append(
+                    "cordum_capacity_items_per_sec"
+                    f"{_fmt_labels({'op': str(r.get('op', '')), 'bucket': str(r.get('bucket', '')), 'worker': str(r.get('worker', ''))})}"
+                    f" {r.get('items_per_s', 0.0)}"
+                )
+            tok_rows = [r for r in cap_rows if float(r.get("tokens_per_s", 0.0)) > 0]
+            if tok_rows:
+                lines.append("# TYPE cordum_capacity_tokens_per_sec gauge")
+                for r in tok_rows:
+                    lines.append(
+                        "cordum_capacity_tokens_per_sec"
+                        f"{_fmt_labels({'op': str(r.get('op', '')), 'bucket': str(r.get('bucket', '')), 'worker': str(r.get('worker', ''))})}"
+                        f" {r.get('tokens_per_s', 0.0)}"
+                    )
         now = time.monotonic()
         lines.append("# TYPE cordum_fleet_instances gauge")
         per_service: dict[str, int] = {}

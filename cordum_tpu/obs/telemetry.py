@@ -167,13 +167,26 @@ class TelemetryExporter:
     def _delta_hists(self, fams: dict[str, dict], full: bool) -> dict[str, dict]:
         out: dict[str, dict] = {}
         for name, fam in fams.items():
+            ex_by_key = {
+                self._key(name, labels): exmap
+                for labels, exmap in fam.get("exemplars") or []
+            }
             changed = []
+            changed_ex = []
             for labels, counts, sum_, total in fam["series"]:
                 k = self._key(name, labels)
                 cur = (tuple(counts), sum_, total)
                 if full or self._last_hists.get(k) != cur:
                     self._last_hists[k] = cur
                     changed.append([labels, counts, sum_, total])
+                    exmap = ex_by_key.get(k)
+                    if exmap:
+                        # exemplars ride with their series (same delta
+                        # cadence: a bucket only gains an exemplar when an
+                        # observation moved the series)
+                        changed_ex.append([labels, exmap])
             if changed:
                 out[name] = {"buckets": fam["buckets"], "series": changed}
+                if changed_ex:
+                    out[name]["exemplars"] = changed_ex
         return out

@@ -113,9 +113,13 @@ class ServingEngine:
         max_concurrent_prefills: int = 1,
         metrics: Optional[Metrics] = None,
         tracer: Optional[Tracer] = None,
+        capacity: Optional[Any] = None,
     ) -> None:
         self.backend = backend
         self.run_blocking = run_blocking  # worker.run_in_executor
+        # capacity observatory (obs/capacity.py): each ragged decode step
+        # reports delivered tokens at its padded-batch bucket
+        self.capacity = capacity
         self.max_sessions = max(1, max_sessions)
         self.max_new_tokens_cap = max(1, max_new_tokens_cap)
         self.max_concurrent_prefills = max(1, max_concurrent_prefills)
@@ -413,6 +417,14 @@ class ServingEngine:
             self.stats.occupancy_sum += len(batch)
             self.stats.max_occupancy = max(self.stats.max_occupancy, len(batch))
             self.stats.step_seconds.append(dt)
+            if self.capacity is not None:
+                # one step decodes one token per rider; bucket = the pow2
+                # batch bucket the XLA program actually ran at
+                self.capacity.observe(
+                    "llm.generate", device_s=dt,
+                    bucket=str(1 << max(0, len(batch) - 1).bit_length()),
+                    items=len(batch), tokens=len(batch),
+                )
             retired_this_step = 0
             emits = []
             for sess, tok in zip(batch, next_tokens):

@@ -18,6 +18,7 @@ crunches.  jitted callables are cached per (op, shape-bucket).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Optional
 
 import numpy as np
@@ -102,7 +103,8 @@ class TPUCompute:
         x = jax.random.normal(kx, (b, n, k), dt)
         y = jax.random.normal(ky, (k, m), dt)
         y_back = jax.random.normal(kb, (m, k), dt)
-        with _maybe_timer(timer, op="matmul", compile_cached=str(compiled).lower()):
+        with _maybe_timer(timer, op="matmul", compile_cached=str(compiled).lower(),
+                          items=str(b), bucket=f"{n}x{k}x{m}"):
             out = jax.block_until_ready(run(x, y, y_back))
         return {
             "shape": list(out.shape),
@@ -143,7 +145,8 @@ class TPUCompute:
             row = [min(x, cfg.vocab_size - 1) for x in row[:t]]
             batch[i, : len(row)] = row
             lens.append(max(1, len(row)))
-        with _maybe_timer(timer, op="infer", compile_cached=str(compiled).lower()):
+        with _maybe_timer(timer, op="infer", compile_cached=str(compiled).lower(),
+                          items=str(len(tokens)), bucket=str(t)):
             logits = self._llama_fwd(self._llama_params, jnp.asarray(batch))
             # score each row at ITS last real token (causal attention makes
             # this invariant to right-padding, so per-job and micro-batched
@@ -174,7 +177,8 @@ class TPUCompute:
         shape = ("embed", bpad, ids.shape[1])
         compiled = shape in self._batch_shapes
         self._batch_shapes.add(shape)
-        with _maybe_timer(timer, op="embed_batch", compile_cached=str(compiled).lower()):
+        with _maybe_timer(timer, op="embed_batch", compile_cached=str(compiled).lower(),
+                          items=str(b), bucket=str(ids.shape[1])):
             out = self.embedder.embed_tokens(ids, mask)
         return np.asarray(out)[:b]
 
@@ -202,7 +206,8 @@ class TPUCompute:
         shape = ("infer", bpad, t)
         compiled = shape in self._batch_shapes
         self._batch_shapes.add(shape)
-        with _maybe_timer(timer, op="infer_batch", compile_cached=str(compiled).lower()):
+        with _maybe_timer(timer, op="infer_batch", compile_cached=str(compiled).lower(),
+                          items=str(b), bucket=str(t)):
             logits = self._llama_fwd(self._llama_params, jnp.asarray(batch))
             last = logits[jnp.arange(bpad), jnp.asarray(lens) - 1]
             next_tokens = np.asarray(jnp.argmax(last, axis=-1))[:b].tolist()
@@ -239,7 +244,7 @@ def make_tpu_handlers(compute: TPUCompute):
                 raise HandlerError("embed op requires texts: list[str]")
 
             def _embed():
-                with ctx.device_timer("device", op="embed"):
+                with ctx.device_timer("device", op="embed", items=str(len(texts))):
                     return compute.embedder.embed(texts)
 
             vecs = await ctx.worker.run_in_executor(_embed)
@@ -340,7 +345,14 @@ def make_micro_batcher(
             def run_embed():
                 return compute.embed_batch(texts, seq_len=bucket)
 
+            t0 = time.perf_counter()
             vecs = await worker.run_in_executor(run_embed)
+            # one flush = one coalesced XLA call delivering len(texts) items
+            # at this length bucket — the capacity matrix's batched-embed row
+            worker.capacity.observe(
+                "embed", device_s=time.perf_counter() - t0,
+                bucket=str(bucket), items=len(texts),
+            )
             out, i = [], 0
             for it in items:
                 out.append({
@@ -356,7 +368,12 @@ def make_micro_batcher(
             def run_infer():
                 return compute.infer_batch(rows, seq_len=bucket)
 
+            t0 = time.perf_counter()
             toks, t = await worker.run_in_executor(run_infer)
+            worker.capacity.observe(
+                "infer", device_s=time.perf_counter() - t0,
+                bucket=str(bucket), items=len(rows),
+            )
             out, i = [], 0
             for it in items:
                 out.append({
@@ -416,6 +433,7 @@ def make_serving_engine(
         max_concurrent_prefills=max_concurrent_prefills,
         metrics=metrics,
         tracer=worker.tracer,
+        capacity=worker.capacity,
     )
 
 

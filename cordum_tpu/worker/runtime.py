@@ -34,6 +34,7 @@ from ..batching.engine import BatchCancelled, BatchParts, MicroBatcher
 from ..infra import logging as logx
 from ..infra.bus import Bus
 from ..infra.memstore import MemoryStore
+from ..obs.capacity import CapacityProfiler
 from ..obs.tracer import Tracer
 from ..protocol import subjects as subj
 from ..protocol.types import (
@@ -155,6 +156,9 @@ class Worker:
         # the decode loop must not starve the per-job lanes
         self._serving: Optional[ServingEngine] = None
         self._telemetry = _device_telemetry()
+        # capacity observatory (ISSUE 10): online per-(op, bucket) device
+        # profiles published in the telemetry beacon's `capacity` block
+        self.capacity = CapacityProfiler(self._telemetry["device_kind"] or "cpu")
         self._busy_since: Optional[float] = None
         self._busy_accum = 0.0
         self._window_start = time.monotonic()
@@ -184,6 +188,20 @@ class Worker:
         Jobs whose payload it recognizes (``serving.parts``) become decode
         sessions; everything else keeps the per-job handler path."""
         self._serving = serving
+        # capacity beacon gauges: KV-page/arena headroom + decode occupancy
+        # (read at snapshot time, never on the decode hot path)
+        alloc = serving.allocator
+        self.capacity.set_kv_headroom(lambda: {
+            "pages_total": alloc.num_pages - 1,  # page 0 is the null page
+            "pages_free": alloc.free_pages,
+            "pages_in_use": alloc.used_pages,
+        })
+        stats = serving.stats
+        self.capacity.set_occupancy(lambda: {
+            "decode_mean": round(stats.mean_occupancy, 3),
+            "decode_max": stats.max_occupancy,
+            "active_sessions": serving.active_sessions(),
+        })
 
     @property
     def serving(self) -> Optional[ServingEngine]:
@@ -395,6 +413,16 @@ class Worker:
                 end_us=end_us,
                 attrs={"job_id": req.job_id, **attrs},
             ))
+        # capacity observatory: successful per-job-path work feeds the
+        # device profiler (the micro-batch flush and the serving decode loop
+        # feed it directly — observing those jobs here would double count)
+        if (
+            status == JobState.SUCCEEDED.value
+            and batch_parts is None
+            and gen_req is None
+        ):
+            self._observe_capacity(req, payload, ctx.device_records,
+                                   time.monotonic() - t0)
         res = JobResult(
             job_id=req.job_id,
             status=status,
@@ -415,6 +443,38 @@ class Worker:
                 span_id=exec_span.span_id, parent_span_id=exec_span.parent_span_id,
             ),
         )
+
+    def _observe_capacity(
+        self, req: JobRequest, payload: Any, device_records: list, wall_s: float
+    ) -> None:
+        """Feed one finished per-job-path job into the capacity profiler:
+        device-timer records when the handler produced them (true device
+        time, compile split, items/bucket attrs), otherwise the execute wall
+        time as the host-op service time."""
+        op = ""
+        if isinstance(payload, dict):
+            op = str(payload.get("op") or "")
+        op = op or req.topic
+        fed = False
+        for name, start_us, end_us, attrs in device_records:
+            if attrs.get("error"):
+                continue  # a raised timer block is not delivered capacity
+            try:
+                items = int(attrs.get("items", "1") or 1)
+            except (TypeError, ValueError):
+                items = 1
+            self.capacity.observe(
+                attrs.get("op") or op,
+                device_s=max(0, end_us - start_us) / 1e6,
+                bucket=str(attrs.get("bucket", "-") or "-"),
+                items=items,
+                compiled=attrs.get("compile_cached") == "false",
+            )
+            fed = True
+        if not fed:
+            # no device timer (echo-class host ops): wall time still tells
+            # the matrix what this worker delivers for the op
+            self.capacity.observe(op, device_s=wall_s, items=1)
 
     @staticmethod
     def _result_subject(req: JobRequest) -> str:
@@ -469,6 +529,10 @@ class Worker:
             "active_jobs": len(self._active),
             "max_parallel_jobs": self.max_parallel_jobs,
             "duty_cycle_pct": round(self._duty_cycle_peek(), 1),
+            # capacity observatory: delta-encoded per-(op, bucket) device
+            # profiles — the fleet aggregator folds these into the op ×
+            # worker throughput matrix (docs/OBSERVABILITY.md)
+            "capacity": self.capacity.snapshot(),
         }
         if self._serving is not None:
             out["serving_sessions"] = self._serving.active_sessions()

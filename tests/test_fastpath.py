@@ -294,7 +294,8 @@ def test_floor_checker_passes_healthy_doc():
            "serving_speedup": 4.5, "serving_affinity_hit_rate": 1.0,
            "decode_tokens_per_sec": 2900.0,
            "statebus_replication_overhead_pct": 8.0,
-           "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5}
+           "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
+           "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4}
     floors = json.loads((REPO / "bench_floor.json").read_text())
     assert mod.check(doc, floors) == []
 
@@ -311,7 +312,8 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "serving_speedup": 4.5, "serving_affinity_hit_rate": 1.0,
            "decode_tokens_per_sec": 2900.0,
            "statebus_replication_overhead_pct": 8.0,
-           "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5}
+           "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
+           "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4}
     violations = mod.check(doc, floors)
     assert violations and "value" in violations[0]
     # ceilings guard the other direction (round-trip budget regression)

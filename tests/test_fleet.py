@@ -43,14 +43,25 @@ POLICY = {"tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}, "rules":
 # ---------------------------------------------------------------------------
 
 
-def _parse_exposition(text: str) -> dict:
+def _parse_exposition(text: str, exemplars: dict = None) -> dict:
     """Minimal conformance parser for the Prometheus text format: returns
     {metric_name: {frozenset(label items): value}} and raises on malformed
-    lines/labels (unterminated quotes, raw newlines, bad floats)."""
+    lines/labels (unterminated quotes, raw newlines, bad floats).
+    OpenMetrics-style exemplar suffixes (`` # {trace_id="..."} v ts``,
+    ISSUE 10) are validated and collected into ``exemplars`` when a dict is
+    passed: {(name, frozenset(labels)): trace_id}."""
     out: dict = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
+        exemplar_tid = None
+        if " # " in line:  # exemplar suffix on a histogram bucket line
+            line, _, ex = line.partition(" # ")
+            assert ex.startswith('{trace_id="'), ex
+            body, _, tail = ex[len('{trace_id="'):].partition('"}')
+            exemplar_tid = body
+            ex_value, ex_ts = tail.split()  # value + timestamp, both floats
+            float(ex_value), float(ex_ts)
         if "{" in line:
             name, rest = line.split("{", 1)
             labels_part, value_part = rest.rsplit("}", 1)
@@ -84,6 +95,8 @@ def _parse_exposition(text: str) -> dict:
             labels = {}
             value = float(value_s)
         out.setdefault(name, {})[frozenset(labels.items())] = value
+        if exemplar_tid is not None and exemplars is not None:
+            exemplars[(name, frozenset(labels.items()))] = exemplar_tid
     return out
 
 

@@ -16,6 +16,12 @@ TPU worker as separate OS processes, then over plain HTTP:
   7. micro-batching: bulk fan-out of ≥32 embed jobs through
      POST /api/v1/jobs:batch coalesces on the worker — at least one flushed
      batch of size ≥8, asserted via the batch span attributes
+  8. fleet telemetry: /api/v1/fleet health beacons for every process,
+     fleet counters == beacon sums, SLO burn rate, `cordumctl top`
+  9. capacity observatory: /api/v1/capacity has a fresh non-zero row for
+     every op the run executed, the fleet exposition carries an e2e
+     exemplar resolving to a stored trace, and `cordumctl capacity` +
+     `cordum traces blame` render
 
 Exit 0 = PASS.  Usage: python tools/platform_smoke.py [--keep]
 """
@@ -414,6 +420,68 @@ def main() -> int:
                 f"({sorted(healthy)}), fleet scheduled={beacon_sum}, slo "
                 f"burn5m={w5['burn_rate']} ({slo['batch']['state']}); "
                 "cordumctl top renders")
+
+            # 9. capacity observatory: GET /api/v1/capacity must report a
+            # fresh non-zero throughput row for every op this run executed
+            # (echo via the workflow/approval jobs, embed via the batch
+            # fan-out), the fleet exposition must carry the matrix gauges
+            # plus an e2e exemplar that resolves to a stored trace, and the
+            # critical-path blame surfaces must render
+            import re
+
+            want_ops = {"echo", "embed"}
+            cap, fresh_ops = {}, set()
+            t0 = time.time()
+            while time.time() - t0 < 45:
+                cap = c.get("/api/v1/capacity").json()
+                fresh_ops = {r["op"] for r in cap.get("matrix", [])
+                             if not r["stale"] and r["items_per_s"] > 0}
+                if want_ops <= fresh_ops:
+                    break
+                time.sleep(1.0)
+            assert want_ops <= fresh_ops, (
+                f"capacity matrix missing fresh ops: {fresh_ops} from "
+                f"{cap.get('matrix')}")
+            ages = [r["age_s"] for r in cap["matrix"] if r["op"] in want_ops]
+            assert ages and min(ages) < 30, f"stale capacity rows: {ages}"
+            assert cap["workers"], cap
+            assert all(cap["ops"].get(op, 0) > 0 for op in want_ops), cap["ops"]
+            fleet_text = httpx.get(f"{API}/metrics?scope=fleet",
+                                   timeout=10.0).text
+            assert "cordum_capacity_items_per_sec" in fleet_text
+            # the acceptance link: an e2e histogram exemplar's trace id must
+            # resolve to a stored trace with spans
+            m = re.search(
+                r'cordum_job_e2e_seconds_bucket\{[^}]*\} [0-9.]+ '
+                r'# \{trace_id="([^"]+)"\}', fleet_text)
+            assert m, "no exemplar on cordum_job_e2e_seconds in fleet scope"
+            ex_trace = c.get(f"/api/v1/traces/{m.group(1)}").json()
+            assert ex_trace.get("span_count", 0) >= 1, ex_trace
+            blame = c.get("/api/v1/traces/analysis").json()
+            assert blame["traces"] > 0, blame
+            assert "execute" in blame["stages"], blame["stages"]
+            share_sum = sum(s["blame_share"] for s in blame["stages"].values())
+            assert 0.98 <= share_sum <= 1.02, (share_sum, blame["stages"])
+            for cmd, needles in (
+                (["capacity"], ("echo", "embed", "items/s")),
+                (["traces", "blame", "--last", "50"],
+                 ("critical-path blame", "execute")),
+            ):
+                cp = subprocess.run(
+                    [sys.executable, "-m", "cordum_tpu.cli", *cmd],
+                    capture_output=True, text=True, timeout=30, cwd=REPO,
+                    env={**os.environ, "CORDUM_API_URL": API,
+                         "CORDUM_API_KEY": H_USER["X-Api-Key"],
+                         "PYTHONPATH": REPO + os.pathsep
+                         + os.environ.get("PYTHONPATH", "")},
+                )
+                assert cp.returncode == 0, (cmd, cp.stderr)
+                for needle in needles:
+                    assert needle in cp.stdout, (cmd, needle, cp.stdout)
+            log(f"9. capacity observatory: fresh rows for {sorted(fresh_ops)}, "
+                f"e2e exemplar {m.group(1)[:8]} resolves "
+                f"({ex_trace['span_count']} spans), blame shares sum to "
+                f"{share_sum:.3f}; cordumctl capacity + traces blame render")
 
         log("PASS")
         return 0
