@@ -11,10 +11,12 @@ WORKER_BATCH_WAIT_MS, and WORKER_BATCHING=0 disables it.
 
 Serving (cordum_tpu/serving, ``llm.generate``) is on by default too; the
 pool stanza's ``serving_cache_pages`` / ``serving_page_size`` /
-``serving_max_sessions`` / ``serving_max_new_tokens`` size the paged KV
-cache and admission control, overridable via WORKER_SERVING_CACHE_PAGES /
-WORKER_SERVING_PAGE_SIZE / WORKER_SERVING_MAX_SESSIONS /
-WORKER_SERVING_MAX_NEW_TOKENS, and WORKER_SERVING=0 disables the engine.
+``serving_max_sessions`` / ``serving_max_new_tokens`` /
+``serving_prefill_budget`` size the paged KV cache, admission control, and
+the ragged step's chunked-prefill token budget, overridable via
+WORKER_SERVING_CACHE_PAGES / WORKER_SERVING_PAGE_SIZE /
+WORKER_SERVING_MAX_SESSIONS / WORKER_SERVING_MAX_NEW_TOKENS /
+WORKER_SERVING_PREFILL_BUDGET, and WORKER_SERVING=0 disables the engine.
 """
 from __future__ import annotations
 
@@ -92,6 +94,8 @@ async def main() -> None:
         or (pool.serving_max_sessions if pool else 0) or 8,
         serving_max_new_tokens=_boot.env_int("WORKER_SERVING_MAX_NEW_TOKENS", 0)
         or (pool.serving_max_new_tokens if pool else 0) or 64,
+        serving_prefill_budget=_boot.env_int("WORKER_SERVING_PREFILL_BUDGET", 0)
+        or (pool.serving_prefill_budget if pool else 0) or 16,
     )
     profiler = RuntimeProfiler(metrics, service="worker")
     telemetry = TelemetryExporter(

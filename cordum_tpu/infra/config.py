@@ -82,6 +82,7 @@ class Pool:
     serving_page_size: int = 0  # token slots per page
     serving_max_sessions: int = 0  # concurrent decode sessions per worker
     serving_max_new_tokens: int = 0  # per-request generation cap
+    serving_prefill_budget: int = 0  # ragged-step chunked-prefill tokens
 
 
 @dataclass
@@ -133,6 +134,7 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
             serving_page_size=int(p.get("serving_page_size") or 0),
             serving_max_sessions=int(p.get("serving_max_sessions") or 0),
             serving_max_new_tokens=int(p.get("serving_max_new_tokens") or 0),
+            serving_prefill_budget=int(p.get("serving_prefill_budget") or 0),
         )
     for topic, pools in (doc.get("topics") or {}).items():
         if isinstance(pools, str):

@@ -63,6 +63,7 @@ POOLS_SCHEMA: dict[str, Any] = {
                         "serving_page_size": _NONNEG_INT,
                         "serving_max_sessions": _NONNEG_INT,
                         "serving_max_new_tokens": _NONNEG_INT,
+                        "serving_prefill_budget": _NONNEG_INT,
                     },
                     "additionalProperties": False,
                 }],

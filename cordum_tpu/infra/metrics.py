@@ -427,6 +427,13 @@ class Metrics:
             "cordum_serving_kv_pages_in_use",
             "KV cache pages currently allocated to sessions",
         )
+        self.serving_compiles = Counter(
+            "cordum_serving_compile_total",
+            "XLA programs compiled by the serving backend, by entry point "
+            "(the ragged mixed prefill+decode entry compiles exactly once "
+            "per process — a higher count is the bucket-recompile cliff "
+            "coming back)",
+        )
         self.session_affinity = Counter(
             "cordum_session_affinity_total",
             "Session-keyed routing outcomes (hit = routed to the worker "
@@ -518,6 +525,7 @@ class Metrics:
             self.serving_retired,
             self.serving_sessions,
             self.serving_kv_pages_in_use,
+            self.serving_compiles,
             self.session_affinity,
             self.spans_dropped,
             self.telemetry_snapshots,

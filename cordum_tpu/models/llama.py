@@ -174,7 +174,7 @@ def _attention(q, k, v, cfg: LlamaConfig, *, causal: bool = True, q_offset=None)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block(x, layer, cfg: LlamaConfig, positions, constrain, mesh=None, collect_kv=False):
+def _block(x, layer, cfg: LlamaConfig, positions, constrain, mesh=None):
     b, t, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -184,7 +184,6 @@ def _block(x, layer, cfg: LlamaConfig, positions, constrain, mesh=None, collect_
     v = (attn_in @ layer["wv"]).reshape(b, t, kvh, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    kv = (k, v) if collect_kv else None  # post-rope K is what the pages cache
     if cfg.use_ring_attention and mesh is not None and mesh.shape.get(AXIS_SP, 1) > 1:
         # ring flavor: K/V never materialize the full sequence anywhere —
         # chunks rotate the sp ring with an online softmax (long contexts)
@@ -206,8 +205,6 @@ def _block(x, layer, cfg: LlamaConfig, positions, constrain, mesh=None, collect_
     up = mlp_in @ layer["w_up"]
     x = x + ((gate * up) @ layer["w_down"])
     x = constrain(x, P(AXIS_DP, AXIS_SP, None))
-    if collect_kv:
-        return x, kv
     return x
 
 
@@ -239,7 +236,7 @@ def forward(
 
 
 # ---------------------------------------------------------------------------
-# paged KV cache: prefill / decode-step entry points (serving subsystem)
+# paged KV cache: the ragged mixed prefill+decode entry (serving subsystem)
 # ---------------------------------------------------------------------------
 #
 # The serving path (cordum_tpu/serving) holds the conversation KV cache as a
@@ -262,95 +259,84 @@ def init_kv_pages(
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
 
-def prefill_forward(
-    params: Params, tokens: jax.Array, cfg: LlamaConfig
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Full-sequence forward that also returns the per-layer post-rope K/V.
-
-    tokens: [B, T] int32 → (logits [B, T, V], k [L, B, T, kvh, hd], v [...]).
-    The caller scatters the K/V of the real (unpadded) positions into the
-    session's KV pages (see :func:`scatter_prefill_kv`)."""
-    b, t = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
-
-    def constrain(x, spec):  # serving prefill is single-host per worker
-        return x
-
-    x = params["embed"][tokens]
-    ks, vs = [], []
-    for layer in params["layers"]:
-        x, (k, v) = _block(x, layer, cfg, positions, constrain, collect_kv=True)
-        ks.append(k)
-        vs.append(v)
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return x @ params["lm_head"], jnp.stack(ks), jnp.stack(vs)
-
-
-def scatter_prefill_kv(
-    k_pages: jax.Array,
-    v_pages: jax.Array,
-    ks: jax.Array,
-    vs: jax.Array,
-    page_ids: jax.Array,
-    slots: jax.Array,
-) -> tuple[jax.Array, jax.Array]:
-    """Write one sequence's prefill K/V into its pages.
-
-    ks/vs: [L, T, kvh, hd] (batch dim already squeezed); page_ids/slots: [T]
-    int32 mapping position t → (page, slot).  Padded tail positions should
-    point at the null page (page 0)."""
-    k_pages = k_pages.at[:, page_ids, slots].set(ks)
-    v_pages = v_pages.at[:, page_ids, slots].set(vs)
-    return k_pages, v_pages
-
-
-def decode_step(
+def ragged_step(
     params: Params,
     k_pages: jax.Array,
     v_pages: jax.Array,
     tokens: jax.Array,
     positions: jax.Array,
     page_tables: jax.Array,
+    token_seq: jax.Array,
+    out_idx: jax.Array,
     cfg: LlamaConfig,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One continuous-batching decode step over the paged KV cache.
+    """One ragged mixed prefill+decode step over the paged KV cache — the
+    Ragged Paged Attention entry point (PAPERS.md): a single XLA program
+    serves any mix of prefill chunks and decode steps over arbitrary
+    per-sequence lengths.
 
-    tokens: [B] int32 (each sequence's last emitted token); positions: [B]
-    int32 (the slot this token occupies — its current length); page_tables:
-    [B, P] int32.  Returns (next_tokens [B] int32, k_pages, v_pages).
+    The batch dimension is **tokens, not sequences**: a decode step
+    contributes one token, a prefill chunk contributes its whole slice, and
+    they ride the same flat buffer.
 
-    The ragged batch is uniform in shape only: each row attends to exactly
-    ``positions[b] + 1`` cached entries via the causal mask, so rows of
-    different lengths (and padding rows parked on the null page) share one
-    XLA program without seeing each other's state."""
-    b = tokens.shape[0]
+    tokens: [T] int32 flat token buffer (decode last-tokens and prefill
+    chunk tokens interleaved; tail padded with 0s mapped to the padding
+    row); positions: [T] int32 global sequence position of each token (==
+    the page slot it writes); page_tables: [S+1, P] int32 per-sequence page
+    tables — row S is the all-null padding row; token_seq: [T] int32 row of
+    ``page_tables`` each token belongs to (padding tokens → S); out_idx:
+    [S] int32 index into the token buffer of each sequence's last fed token
+    (the sampling position; unused rows point anywhere).  Returns
+    (next_tokens [S] int32, k_pages, v_pages).
+
+    Shape discipline is the whole point: every operand has a static shape
+    regardless of how many sequences are live or how long each one is, so
+    the program compiles exactly ONCE — no prompt-length buckets, no batch
+    buckets, no recompile cliff when sessions join or leave.  Raggedness is
+    expressed through the metadata: each token writes its K/V at
+    ``(page_tables[token_seq[t]][positions[t] // ps], positions[t] % ps)``
+    *before* the gather, then attends to its own sequence's pages under the
+    causal mask ``k_pos <= position`` — in-chunk tokens see each other
+    exactly as a full-sequence forward would, padding rows park on the null
+    page, and no token can reach another sequence's pages because the
+    gather walks only its own page-table row.  (This is the gather-based
+    jnp formulation that runs anywhere; a Pallas kernel walking the page
+    table in VMEM is the TPU upgrade path.)"""
+    t_buf = tokens.shape[0]
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     ps = k_pages.shape[2]
-    pos2 = positions[:, None]  # [B, 1]
-    page_idx = jnp.take_along_axis(page_tables, pos2 // ps, axis=1)[:, 0]  # [B]
+    pos2 = positions[:, None]  # [T, 1]
+    pt_tok = page_tables[token_seq]  # [T, P] — each token's own table row
+    page_idx = jnp.take_along_axis(pt_tok, pos2 // ps, axis=1)[:, 0]  # [T]
     slot = positions % ps
-    x = params["embed"][tokens][:, None, :]  # [B, 1, d]
+    x = params["embed"][tokens][:, None, :]  # [T, 1, d]
     for li, layer in enumerate(params["layers"]):
         attn_in = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (attn_in @ layer["wq"]).reshape(b, 1, h, hd)
-        k = (attn_in @ layer["wk"]).reshape(b, 1, kvh, hd)
-        v = (attn_in @ layer["wv"]).reshape(b, 1, kvh, hd)
+        q = (attn_in @ layer["wq"]).reshape(t_buf, 1, h, hd)
+        k = (attn_in @ layer["wk"]).reshape(t_buf, 1, kvh, hd)
+        v = (attn_in @ layer["wv"]).reshape(t_buf, 1, kvh, hd)
         q = rope(q, pos2, cfg.rope_theta)
         k = rope(k, pos2, cfg.rope_theta)
-        # append this token's K/V to its page BEFORE the gather so the token
-        # attends to itself
+        # write EVERY token's K/V before the gather: a prefill chunk's later
+        # tokens must attend to its earlier ones within the same call (the
+        # causal mask cuts the other direction), and a decode token must
+        # attend to itself
         k_pages = k_pages.at[li, page_idx, slot].set(k[:, 0])
         v_pages = v_pages.at[li, page_idx, slot].set(v[:, 0])
-        kc = k_pages[li][page_tables].reshape(b, -1, kvh, hd)  # [B, P*ps, kvh, hd]
-        vc = v_pages[li][page_tables].reshape(b, -1, kvh, hd)
+        kc = k_pages[li][pt_tok].reshape(t_buf, -1, kvh, hd)  # [T, P*ps, ...]
+        vc = v_pages[li][pt_tok].reshape(t_buf, -1, kvh, hd)
         attn = _attention(q, kc, vc, cfg, q_offset=pos2)
-        x = x + (attn.reshape(b, 1, h * hd) @ layer["wo"])
+        x = x + (attn.reshape(t_buf, 1, h * hd) @ layer["wo"])
         mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(mlp_in @ layer["w_gate"])
         up = mlp_in @ layer["w_up"]
         x = x + ((gate * up) @ layer["w_down"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"])[:, 0]  # [B, V]
+    # lm_head only at the sampling positions (each sequence's last fed
+    # token), not all T buffer rows — prefill chunk interiors never pay the
+    # vocab projection
+    xo = x[:, 0][out_idx]  # [S, d]
+    logits = xo @ params["lm_head"]  # [S, V]
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
 
 

@@ -6,18 +6,21 @@ state.  This package adds the serving path:
 
   * :class:`PageAllocator` — block-granular KV-page bookkeeping over a
     preallocated cache arena (page 0 reserved as the null page)
-  * :class:`LlamaServingBackend` — the XLA side: bucketed prefill +
-    one ragged paged-attention decode call per step
+  * :class:`LlamaServingBackend` — the XLA side: ONE ragged paged-
+    attention entry point (:class:`StepEntry` rows over a static flat
+    token buffer) serving any mix of prefill chunks and decode steps in a
+    single device call — one compiled program, no length/batch buckets
   * :class:`ServingEngine` — the continuous-batching loop: admits new
-    sessions and retires finished ones every step, separates prefill from
-    the decode batch, streams tokens, frees pages on retirement/cancel
+    sessions and retires finished ones every step, schedules chunked
+    prefill *inside* the mixed step under a token budget, streams tokens,
+    frees pages on retirement/cancel
 
 ``llm.generate`` jobs route here from the worker intake (see
 ``worker/runtime.py``); the scheduler pins a conversation's jobs to the
 worker holding its KV pages via the ``cordum.session_key`` affinity map
 (``controlplane/scheduler/strategy.py``).
 """
-from .backend import LlamaServingBackend
+from .backend import LlamaServingBackend, StepEntry
 from .engine import GenRequest, ServingEngine, ServingStats, SessionCancelled
 from .pager import CacheExhausted, PageAllocator
 
@@ -29,4 +32,5 @@ __all__ = [
     "ServingEngine",
     "ServingStats",
     "SessionCancelled",
+    "StepEntry",
 ]

@@ -1,42 +1,79 @@
-"""The XLA half of the serving path: bucketed prefill + paged decode steps.
+"""The XLA half of the serving path: ONE ragged mixed prefill+decode entry.
 
 One backend per worker process owns the KV-page arena (``models/llama``
-``init_kv_pages``) and the jitted entry points.  Shape discipline keeps the
-program count bounded (the batching/buckets ladder trick):
+``init_kv_pages``) and a single jitted program (``models/llama``
+``ragged_step``).  Every device call — a decode step over the live
+sessions, a chunk of some prompt's prefill, or any mix of the two — flows
+through :meth:`step` with the same static operand shapes:
 
-  * prefill compiles one program per prompt *length bucket* (pow2 ladder);
-  * decode compiles one program per *batch bucket* — the page-table width is
-    static, so join/leave only moves a session between batch buckets.
+  * a flat token buffer of ``max_batch_tokens`` slots (decode last-tokens
+    and prefill chunk tokens interleaved, tail padded onto the null page);
+  * per-sequence metadata: page tables ``[max_seqs + 1, pages_per_seq]``
+    (the +1 row is the all-null padding row), per-token sequence ids and
+    positions, and each sequence's sampling index.
 
-Both entry points are **blocking** (called from the worker's executor
-threads) and serialize page-arena mutations under one lock: the functional
-``.at[].set`` updates would silently drop each other's writes if a prefill
-and a decode step interleaved on the same arrays.  Phase separation is the
-engine's job (a prefill never rides *inside* a decode batch; see
-docs/SERVING.md "Prefill/decode separation").
+Because the shapes never change, XLA compiles exactly **one** program —
+there is no prompt-length bucket ladder, no pow2 batch buckets, and no
+recompile cliff when sessions join or leave (the Ragged Paged Attention
+argument, PAPERS.md).  ``compiled_programs()`` and the
+``cordum_serving_compile_total{entry}`` counter make that a measured
+number, and ``last_step_compiled`` lets the capacity observatory keep
+warmup compiles out of the steady-state throughput rows.
+
+:meth:`step` is **blocking** (called from the worker's executor threads)
+and serializes page-arena mutations under one lock: the functional
+``.at[].set`` updates would silently drop each other's writes if two steps
+interleaved on the same arrays.  The engine issues one step at a time, so
+the lock is a safety net for the compat wrappers (:meth:`prefill` /
+:meth:`decode`) that tests and benches drive directly.
 """
 from __future__ import annotations
 
 import threading
+from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 import numpy as np
 
-from ..batching.buckets import bucket_for, pow2_buckets
-from ..models import llama
+DEFAULT_MAX_SEQS = 16
+
+
+@dataclass
+class StepEntry:
+    """One sequence's contribution to a mixed ragged step.
+
+    A decode step feeds exactly one token (the session's last emitted
+    token) at its current position; a prefill chunk feeds a slice of the
+    prompt starting at ``start``.  ``sample=True`` asks for the next token
+    from the last fed position (always for decode; only for the chunk that
+    completes a prompt)."""
+
+    tokens: list[int]
+    start: int  # global sequence position of tokens[0]
+    pages: list[int]  # the session's page list (page-table row prefix)
+    sample: bool = True
+    phase: str = "decode"  # "prefill" | "decode" — observability + fakes
+    key: str = ""  # session/job id — observability + fakes
 
 
 class LlamaServingBackend:
     def __init__(
         self,
-        cfg: Optional[llama.LlamaConfig] = None,
+        cfg: Any = None,
         *,
         num_pages: int = 128,
         page_size: int = 16,
         max_context: int = 0,
+        max_seqs: int = 0,
+        max_batch_tokens: int = 0,
         seed: int = 0,
         params_provider: Optional[Callable[[], Any]] = None,
+        metrics: Any = None,
     ) -> None:
+        # lazy model import keeps this module (and the engine importing it
+        # for StepEntry) jax-free until a real backend is constructed
+        from ..models import llama
+
         self.cfg = cfg or llama.LlamaConfig.tiny()
         self.page_size = max(1, page_size)
         self.num_pages = max(2, num_pages)
@@ -45,17 +82,24 @@ class LlamaServingBackend:
             max_context or self.cfg.max_seq_len, self.cfg.max_seq_len
         )
         self.pages_per_seq = -(-self.max_context // self.page_size)
+        # static ragged-step shapes: S sequence rows (+1 padding row) over a
+        # T-slot flat token buffer.  T - S is the headroom prefill chunks
+        # ride in when the decode set is full (the chunked-prefill budget).
+        self.max_seqs = max(1, max_seqs or DEFAULT_MAX_SEQS)
+        self.max_batch_tokens = max(
+            self.max_seqs, max_batch_tokens or 2 * self.max_seqs
+        )
         self._seed = seed
         self._params_provider = params_provider
         self._params: Any = None
         self._k_pages: Any = None
         self._v_pages: Any = None
-        self._prefill_jit: Any = None
-        self._decode_jit: Any = None
-        self._prefill_buckets = pow2_buckets(8, self.max_context)
+        self._ragged_jit: Any = None
         self._compiled_shapes: set = set()  # observability: program count
-        # page-arena mutation lock: prefill and decode both read-modify-write
-        # the K/V arrays from executor threads
+        self._metrics = metrics
+        self.last_step_compiled = False  # did the latest step() pay XLA?
+        # page-arena mutation lock: steps read-modify-write the K/V arrays
+        # from executor threads
         self._dev_lock = threading.Lock()
 
     # ------------------------------------------------------------------
@@ -63,6 +107,8 @@ class LlamaServingBackend:
         if self._params is not None:
             return
         import jax
+
+        from ..models import llama
 
         if self._params_provider is not None:
             self._params = self._params_provider()
@@ -72,11 +118,14 @@ class LlamaServingBackend:
             self.cfg, self.num_pages, self.page_size
         )
         cfg = self.cfg
-        self._prefill_jit = jax.jit(lambda p, t: llama.prefill_forward(p, t, cfg))
-        self._decode_jit = jax.jit(
-            lambda p, kp, vp, toks, pos, pt: llama.decode_step(
-                p, kp, vp, toks, pos, pt, cfg
-            )
+        # donate the page arenas on real accelerators so the in-place
+        # update never copies the arena; CPU jax spams donation warnings
+        donate = (jax.default_backend() != "cpu")
+        self._ragged_jit = jax.jit(
+            lambda p, kp, vp, toks, pos, pt, ts, oi: llama.ragged_step(
+                p, kp, vp, toks, pos, pt, ts, oi, cfg
+            ),
+            donate_argnums=(1, 2) if donate else (),
         )
 
     def compiled_programs(self) -> int:
@@ -87,61 +136,105 @@ class LlamaServingBackend:
         return [min(max(0, int(t)), vmax) for t in row]
 
     # ------------------------------------------------------------------
-    def prefill(self, prompt: list[int], pages: list[int]) -> int:
-        """Run the prompt through the full forward, write its K/V into
-        ``pages``, and return the first generated token.  Blocking; call
-        from an executor thread."""
+    def step(self, entries: list[StepEntry]) -> list[Optional[int]]:
+        """One ragged mixed prefill+decode device call.
+
+        Returns one value per entry, aligned: the next token for sampled
+        entries, ``None`` for prefill chunks that do not complete their
+        prompt.  Blocking; call from an executor thread."""
         import jax.numpy as jnp
 
         self._ensure()
-        row = self._clamp(prompt)[: self.max_context]
-        t = max(1, len(row))
-        tb = bucket_for(t, self._prefill_buckets)
-        batch = np.zeros((1, tb), np.int32)
-        batch[0, : len(row)] = row
-        # position → (page, slot); the padded tail scatters to the null page
-        pos = np.arange(tb)
-        page_ids = np.zeros((tb,), np.int32)
-        page_arr = np.asarray(pages, np.int32)
-        page_ids[:t] = page_arr[pos[:t] // self.page_size]
-        slots = (pos % self.page_size).astype(np.int32)
-        self._compiled_shapes.add(("prefill", tb))
-        with self._dev_lock:
-            logits, ks, vs = self._prefill_jit(self._params, jnp.asarray(batch))
-            self._k_pages, self._v_pages = llama.scatter_prefill_kv(
-                self._k_pages, self._v_pages, ks[:, 0], vs[:, 0],
-                jnp.asarray(page_ids), jnp.asarray(slots),
+        if not entries:
+            return []
+        t_buf, s_rows = self.max_batch_tokens, self.max_seqs
+        if len(entries) > s_rows:
+            raise ValueError(
+                f"{len(entries)} sequences in one step; backend max_seqs is "
+                f"{s_rows}"
             )
-            first = int(jnp.argmax(logits[0, t - 1]))
-        return first
+        total = sum(len(e.tokens) for e in entries)
+        if total > t_buf:
+            raise ValueError(
+                f"{total} tokens in one step; backend max_batch_tokens is "
+                f"{t_buf}"
+            )
+        tokens = np.zeros((t_buf,), np.int32)
+        positions = np.zeros((t_buf,), np.int32)
+        # padding tokens map to the padding row (all null pages): their
+        # writes land on page 0 and no live sequence's gather can see them
+        token_seq = np.full((t_buf,), s_rows, np.int32)
+        tables = np.zeros((s_rows + 1, self.pages_per_seq), np.int32)
+        out_idx = np.zeros((s_rows,), np.int32)
+        ti = 0
+        for i, e in enumerate(entries):
+            row = self._clamp(e.tokens)
+            n = len(row)
+            if not n:
+                raise ValueError("empty StepEntry.tokens")
+            if e.start + n > self.max_context:
+                raise ValueError(
+                    f"entry spans positions [{e.start}, {e.start + n}); "
+                    f"backend max_context is {self.max_context}"
+                )
+            tokens[ti:ti + n] = row
+            positions[ti:ti + n] = np.arange(e.start, e.start + n)
+            token_seq[ti:ti + n] = i
+            tables[i, : len(e.pages)] = e.pages
+            out_idx[i] = ti + n - 1
+            ti += n
+        shape_key = ("ragged", t_buf, s_rows, self.pages_per_seq)
+        self.last_step_compiled = shape_key not in self._compiled_shapes
+        if self.last_step_compiled:
+            self._compiled_shapes.add(shape_key)
+            if self._metrics is not None:
+                self._metrics.serving_compiles.inc(entry="ragged")
+        with self._dev_lock:
+            nxt, self._k_pages, self._v_pages = self._ragged_jit(
+                self._params, self._k_pages, self._v_pages,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables), jnp.asarray(token_seq),
+                jnp.asarray(out_idx),
+            )
+            out = np.asarray(nxt)
+        return [int(out[i]) if e.sample else None
+                for i, e in enumerate(entries)]
 
     # ------------------------------------------------------------------
+    # compat conveniences over step() — tests and benches drive these; the
+    # engine always assembles mixed steps itself.  Both ride the SAME
+    # ragged program: there is nothing else to compile.
+    def prefill(self, prompt: list[int], pages: list[int]) -> int:
+        """Run a whole prompt through ragged prefill chunks (token-budget
+        sized) and return the first generated token.  Blocking."""
+        row = list(prompt)[: self.max_context]
+        total = max(1, len(row)) or 1
+        first: Optional[int] = None
+        start = 0
+        while start < total or first is None:
+            chunk = row[start:start + self.max_batch_tokens] or [0]
+            done = start + len(chunk) >= total
+            (first,) = self.step([StepEntry(
+                tokens=chunk, start=start, pages=pages, sample=done,
+                phase="prefill",
+            )])
+            start += len(chunk)
+            if done:
+                break
+        assert first is not None
+        return first
+
     def decode(self, entries: list[tuple[int, int, list[int]]]) -> list[int]:
-        """One decode step for a ragged batch.
-
-        ``entries``: per-session ``(last_token, position, pages)`` where
-        ``position`` is the slot the last token occupies (== tokens cached
-        so far).  Returns one next token per entry.  Blocking; call from an
-        executor thread."""
-        import jax.numpy as jnp
-
-        self._ensure()
-        b = len(entries)
-        if b == 0:
-            return []
-        bp = 1 << (b - 1).bit_length()  # pad batch to the pow2 bucket
-        tokens = np.zeros((bp,), np.int32)
-        positions = np.zeros((bp,), np.int32)
-        tables = np.zeros((bp, self.pages_per_seq), np.int32)  # null-page fill
-        for i, (tok, pos, pages) in enumerate(entries):
-            tokens[i] = min(max(0, int(tok)), self.cfg.vocab_size - 1)
-            positions[i] = pos
-            tables[i, : len(pages)] = pages
-        self._compiled_shapes.add(("decode", bp))
-        with self._dev_lock:
-            nxt, self._k_pages, self._v_pages = self._decode_jit(
-                self._params, self._k_pages, self._v_pages,
-                jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
-            )
-            out = np.asarray(nxt)[:b].tolist()
+        """One decode step for a ragged batch of ``(last_token, position,
+        pages)`` triples — one next token per entry.  Batches wider than
+        the static shapes split across step() calls.  Blocking."""
+        out: list[int] = []
+        width = min(self.max_seqs, self.max_batch_tokens)
+        for lo in range(0, len(entries), width):
+            chunk = entries[lo:lo + width]
+            res = self.step([StepEntry(
+                tokens=[tok], start=pos, pages=pages, sample=True,
+                phase="decode",
+            ) for tok, pos, pages in chunk])
+            out.extend(int(t) for t in res if t is not None)
         return out

@@ -1,4 +1,4 @@
-"""The continuous-batching decode loop (the serving subsystem's scheduler).
+"""The continuous-batching loop (the serving subsystem's scheduler).
 
 Lifecycle of an ``llm.generate`` session (docs/SERVING.md):
 
@@ -6,14 +6,18 @@ Lifecycle of an ``llm.generate`` session (docs/SERVING.md):
     queue**; admission allocates its full worst-case page footprint
     (prompt + max_new_tokens) so an admitted session can never die
     mid-decode from cache pressure — exhaustion just delays admission;
-  * admitted sessions **prefill** off the decode path (a separate XLA call
-    on an executor thread, never inside a decode batch), bounded by
-    ``max_concurrent_prefills`` so a burst of long prompts cannot starve
-    in-flight decodes (the FlexNPU co-location policy, PAPERS.md);
-  * prefilled sessions join the **decode set**: every step assembles one
-    ragged batch from the per-session page tables, runs ONE XLA decode
-    call, scatters tokens back, admits joiners and retires finishers —
-    sessions join/leave mid-flight without perturbing each other's rows;
+  * admitted sessions join the step loop immediately: their prompts
+    **prefill in chunks inside the mixed step**, riding the token-budget
+    headroom left after the decode rows (the FlexNPU co-location policy,
+    PAPERS.md, realized the Ragged Paged Attention way — prefill and
+    decode share ONE device call instead of racing for the device lock
+    from separate executor threads);
+  * every step assembles one ragged batch — one decode row per prefilled
+    session plus up to ``max_concurrent_prefills`` prompt chunks within
+    the backend's flat token budget — runs ONE XLA call (the single
+    compiled program), scatters tokens back, admits joiners and retires
+    finishers; sessions join/leave mid-stream without perturbing each
+    other's rows and without recompiling anything;
   * retirement (finish / cancel / failure) frees the session's pages back
     to the allocator and resolves the submit waiter.
 
@@ -33,6 +37,7 @@ from typing import Any, Awaitable, Callable, Optional
 from ..infra import logging as logx
 from ..infra.metrics import Metrics
 from ..obs.tracer import Tracer
+from .backend import StepEntry
 from .pager import CacheExhausted, PageAllocator
 
 # on_tokens(new_tokens, n_generated, done) — the streaming sink
@@ -40,6 +45,9 @@ TokenSink = Callable[[list[int], int, bool], Awaitable[None]]
 
 DEFAULT_MAX_SESSIONS = 8
 DEFAULT_MAX_NEW_TOKENS = 64
+# prefill chunks co-scheduled into one mixed step: more rows admit faster,
+# but each chunk spends flat-buffer slots the decode rows also want
+DEFAULT_MAX_CONCURRENT_PREFILLS = 2
 
 
 class SessionCancelled(Exception):
@@ -65,11 +73,13 @@ class ServingStats:
     cancelled: int = 0
     failed: int = 0
     steps: int = 0
-    decoded_tokens: int = 0
+    decoded_tokens: int = 0  # generated tokens (decode rows + first tokens)
+    prefill_tokens: int = 0  # prompt tokens fed through mixed-step chunks
+    prefill_chunks: int = 0
     occupancy_sum: int = 0
     max_occupancy: int = 0
     admission_waits: int = 0  # admissions delayed by cache exhaustion
-    # per-step wall time (seconds), capped ring for p50 inter-token latency
+    # per-step wall time (seconds), capped ring for inter-token p50/p99
     step_seconds: deque = field(default_factory=lambda: deque(maxlen=4096))
 
     @property
@@ -87,10 +97,15 @@ class _Session:
     parent_span_id: str = ""
     pages: list[int] = field(default_factory=list)
     pos: int = 0  # sequence positions cached so far
+    prefill_pos: int = 0  # prompt tokens fed so far (== pos until prefilled)
     last_token: int = 0
     out_tokens: list[int] = field(default_factory=list)
     cancelled: bool = False
     enqueued_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def prefilled(self) -> bool:
+        return self.prefill_pos >= len(self.req.prompt)
 
     @property
     def done(self) -> bool:
@@ -110,15 +125,16 @@ class ServingEngine:
         run_blocking: Callable[..., Awaitable[Any]],
         max_sessions: int = DEFAULT_MAX_SESSIONS,
         max_new_tokens_cap: int = DEFAULT_MAX_NEW_TOKENS,
-        max_concurrent_prefills: int = 1,
+        max_concurrent_prefills: int = DEFAULT_MAX_CONCURRENT_PREFILLS,
         metrics: Optional[Metrics] = None,
         tracer: Optional[Tracer] = None,
         capacity: Optional[Any] = None,
     ) -> None:
         self.backend = backend
         self.run_blocking = run_blocking  # worker.run_in_executor
-        # capacity observatory (obs/capacity.py): each ragged decode step
-        # reports delivered tokens at its padded-batch bucket
+        # capacity observatory (obs/capacity.py): each ragged step reports
+        # delivered tokens at the static flat-buffer bucket, with warmup
+        # compiles flagged so steady-state rows exclude them
         self.capacity = capacity
         self.max_sessions = max(1, max_sessions)
         self.max_new_tokens_cap = max(1, max_new_tokens_cap)
@@ -129,12 +145,20 @@ class ServingEngine:
         # footprint; anything longer must be rejected at submit (the arena
         # may hold far more pages than one table row can address)
         self.max_context = int(getattr(backend, "max_context", 0) or 0)
+        # the flat token buffer bounds decode rows + prefill chunk tokens
+        # per step; every admitted session must at least fit a decode row
+        self.step_tokens = int(
+            getattr(backend, "max_batch_tokens", 0) or 2 * self.max_sessions
+        )
+        self.max_sessions = min(
+            self.max_sessions,
+            int(getattr(backend, "max_seqs", 0) or self.max_sessions),
+            self.step_tokens,
+        )
         self.allocator = PageAllocator(backend.num_pages, backend.page_size)
         self.stats = ServingStats()
         self._pending: deque[_Session] = deque()
-        self._prefilling: dict[str, _Session] = {}
         self._active: dict[str, _Session] = {}
-        self._prefill_tasks: set[asyncio.Task] = set()
         self._wake = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
         self._closed = False
@@ -171,7 +195,7 @@ class ServingEngine:
     # ------------------------------------------------------------------
     @property
     def session_count(self) -> int:
-        return len(self._pending) + len(self._prefilling) + len(self._active)
+        return len(self._pending) + len(self._active)
 
     def queue_depth(self) -> int:
         return len(self._pending)
@@ -195,8 +219,8 @@ class ServingEngine:
         total = len(gen.prompt) + gen.max_new_tokens
         if self.max_context and total > self.max_context:
             # beyond the backend's static page-table width: prefill would
-            # silently truncate and the first decode step would poison the
-            # whole batch — fail this job alone, before it becomes a session
+            # silently truncate and the session would poison its step —
+            # fail this job alone, before it becomes a session
             raise ValueError(
                 f"request spans {total} tokens (prompt {len(gen.prompt)} + "
                 f"{gen.max_new_tokens} new); backend max_context is "
@@ -231,9 +255,9 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def cancel(self, job_id: str) -> bool:
         """Evict a session wherever it is: admission queue (pages never
-        allocated), prefilling, or the decode set (pages freed by the loop
-        on the next tick).  Returns False when the job is not a live
-        session."""
+        allocated) or the step loop — prefilling or decoding, the pages are
+        freed by the loop on its next tick.  Returns False when the job is
+        not a live session."""
         for i, sess in enumerate(self._pending):
             if sess.job_id == job_id:
                 del self._pending[i]
@@ -241,9 +265,9 @@ class ServingEngine:
                 # (pages were never allocated; free() is a no-op here)
                 self._retire(sess, error=SessionCancelled(job_id))
                 return True
-        sess = self._prefilling.get(job_id) or self._active.get(job_id)
+        sess = self._active.get(job_id)
         if sess is not None:
-            sess.cancelled = True  # loop/prefill task retires + frees pages
+            sess.cancelled = True  # the loop retires + frees pages
             self._wake.set()
             return True
         return False
@@ -255,22 +279,20 @@ class ServingEngine:
             self._loop_task.add_done_callback(self._on_loop_done)
 
     def _on_loop_done(self, task: asyncio.Task) -> None:
-        """Decode-step failures are handled inside the loop; anything that
-        still escapes must not strand live sessions on never-resolving
-        futures — fail them loudly (each publishes an ordinary FAILED
-        result) and let the next submit restart the loop."""
+        """Step failures are handled inside the loop; anything that still
+        escapes must not strand live sessions on never-resolving futures —
+        fail them loudly (each publishes an ordinary FAILED result) and let
+        the next submit restart the loop."""
         if task.cancelled() or self._closed:
             return
         exc = task.exception()
         if exc is None:
             return
         logx.warn("decode loop crashed; failing live sessions", err=str(exc))
-        for sess in [*self._pending, *self._prefilling.values(),
-                     *self._active.values()]:
+        for sess in [*self._pending, *self._active.values()]:
             self.stats.failed += 1
             self._retire(sess, error=exc)
         self._pending.clear()
-        self._prefilling.clear()
 
     def _gauge(self) -> None:
         if self.metrics is not None:
@@ -278,13 +300,11 @@ class ServingEngine:
             self.metrics.serving_kv_pages_in_use.set(float(self.allocator.used_pages))
 
     def _admit(self) -> None:
-        """Move pending sessions into prefill while pages and session slots
-        allow; FIFO so exhaustion delays but never reorders admission."""
-        while (
-            self._pending
-            and len(self._prefilling) < self.max_concurrent_prefills
-            and len(self._active) + len(self._prefilling) < self.max_sessions
-        ):
+        """Move pending sessions straight into the step loop while pages
+        and session slots allow; FIFO so exhaustion delays but never
+        reorders admission.  An admitted session needs no separate prefill
+        phase — its prompt chunks ride the next steps' token budget."""
+        while self._pending and len(self._active) < self.max_sessions:
             sess = self._pending[0]
             if sess.cancelled:
                 self._pending.popleft()
@@ -300,40 +320,10 @@ class ServingEngine:
                 break  # head-of-line waits for a retirement to free pages
             self._pending.popleft()
             sess.pages = pages
-            self._prefilling[sess.job_id] = sess
+            self._active[sess.job_id] = sess
             self.stats.admitted += 1
             if self.metrics is not None:
                 self.metrics.serving_admitted.inc()
-            t = asyncio.ensure_future(self._prefill(sess))
-            self._prefill_tasks.add(t)
-            t.add_done_callback(self._prefill_tasks.discard)
-
-    async def _prefill(self, sess: _Session) -> None:
-        try:
-            first = await self.run_blocking(
-                self.backend.prefill, sess.req.prompt, sess.pages
-            )
-        except Exception as e:  # noqa: BLE001 - surfaces as the job's failure
-            self._prefilling.pop(sess.job_id, None)
-            self.stats.failed += 1
-            self._retire(sess, error=e)
-            self._wake.set()
-            return
-        self._prefilling.pop(sess.job_id, None)
-        if sess.cancelled:
-            self._retire(sess, error=SessionCancelled(sess.job_id))
-            self._wake.set()
-            return
-        sess.pos = min(len(sess.req.prompt), self.backend.max_context)
-        sess.last_token = first
-        sess.out_tokens.append(first)
-        await self._emit(sess, [first])
-        if sess.done:
-            self._retire(sess)
-        else:
-            self._active[sess.job_id] = sess
-        self._gauge()
-        self._wake.set()
 
     async def _emit(self, sess: _Session, new_tokens: list[int]) -> None:
         if sess.on_tokens is None:
@@ -364,87 +354,138 @@ class ServingEngine:
                 sess.future.set_exception(error)
 
     # ------------------------------------------------------------------
+    def _assemble(self) -> tuple[list[StepEntry], list[tuple[_Session, int, bool]]]:
+        """Build one mixed step: a decode row for every prefilled session,
+        then prompt chunks for prefilling ones (admission order) within the
+        flat token budget and the per-step chunk cap.  Returns the entries
+        plus aligned ``(session, chunk_len, samples)`` bookkeeping."""
+        entries: list[StepEntry] = []
+        rows: list[tuple[_Session, int, bool]] = []
+        budget = self.step_tokens
+        chunks = 0
+        for sess in self._active.values():
+            if not sess.prefilled:
+                continue
+            entries.append(StepEntry(
+                tokens=[sess.last_token], start=sess.pos, pages=sess.pages,
+                sample=True, phase="decode", key=sess.job_id,
+            ))
+            rows.append((sess, 1, True))
+            budget -= 1
+        for sess in self._active.values():
+            if sess.prefilled or budget <= 0 or chunks >= self.max_concurrent_prefills:
+                continue
+            chunk = min(budget, len(sess.req.prompt) - sess.prefill_pos)
+            completes = sess.prefill_pos + chunk >= len(sess.req.prompt)
+            entries.append(StepEntry(
+                tokens=sess.req.prompt[sess.prefill_pos:sess.prefill_pos + chunk],
+                start=sess.prefill_pos, pages=sess.pages,
+                sample=completes, phase="prefill", key=sess.job_id,
+            ))
+            rows.append((sess, chunk, completes))
+            budget -= chunk
+            chunks += 1
+        return entries, rows
+
     async def _decode_loop(self) -> None:
         """The continuous-batching loop: one ragged XLA call per step over
-        every active session; admission and retirement happen between
-        steps, never inside one."""
+        every active session — decode rows and prefill chunks mixed;
+        admission and retirement happen between steps, never inside one."""
         while not self._closed:
             self._admit()
             # evict cancellations before assembling the batch
             for sess in [s for s in self._active.values() if s.cancelled]:
                 self._retire(sess, error=SessionCancelled(sess.job_id))
-            batch = list(self._active.values())
-            if not batch:
+            if not self._active:
                 self._gauge()
-                if not self._pending and not self._prefilling:
+                if not self._pending:
                     if self._closed:
                         return
                     self._wake.clear()
                     # re-check after clear: a submit may have landed between
                     # the emptiness check and the clear
-                    if not (self._pending or self._prefilling or self._active):
+                    if not (self._pending or self._active):
                         await self._wake.wait()
                 else:
-                    await asyncio.sleep(0.001)  # prefill in flight: poll soon
+                    await asyncio.sleep(0.001)  # pages freeing: poll soon
+                continue
+            entries, rows = self._assemble()
+            if not entries:  # defensive: all rows parked past the budget
+                await asyncio.sleep(0.001)
                 continue
             t0 = time.monotonic()
-            entries = [(s.last_token, s.pos, s.pages) for s in batch]
             step_span = None
-            if self.tracer is not None and batch[0].trace_id:
-                oldest = min(batch, key=lambda s: s.enqueued_at)
+            if self.tracer is not None and rows[0][0].trace_id:
+                oldest = min((r[0] for r in rows), key=lambda s: s.enqueued_at)
                 step_span = self.tracer.begin(
                     "decode-step", trace_id=oldest.trace_id,
                     parent_span_id=oldest.parent_span_id,
-                    attrs={"occupancy": str(len(batch))},
+                    attrs={"occupancy": str(len(rows))},
                 )
             try:
-                next_tokens = await self.run_blocking(self.backend.decode, entries)
+                results = await self.run_blocking(self.backend.step, entries)
             except Exception as e:  # noqa: BLE001 - whole-step failure
                 # a poisoned step fails every rider (pages freed); the next
                 # tick starts clean — mirrors the batcher's isolation intent
                 # without re-running autoregressive state per item
-                logx.warn("decode step failed", occupancy=len(batch), err=str(e))
+                logx.warn("serving step failed", occupancy=len(rows), err=str(e))
                 if step_span is not None and self.tracer is not None:
                     step_span.attrs["error"] = type(e).__name__
                     await self.tracer.finish(step_span, status="ERROR")
-                for sess in batch:
+                for sess, _, _ in rows:
                     self.stats.failed += 1
                     self._retire(sess, error=e)
                 continue
             dt = time.monotonic() - t0
-            self.stats.steps += 1
-            self.stats.decoded_tokens += len(batch)
-            self.stats.occupancy_sum += len(batch)
-            self.stats.max_occupancy = max(self.stats.max_occupancy, len(batch))
-            self.stats.step_seconds.append(dt)
-            if self.capacity is not None:
-                # one step decodes one token per rider; bucket = the pow2
-                # batch bucket the XLA program actually ran at
-                self.capacity.observe(
-                    "llm.generate", device_s=dt,
-                    bucket=str(1 << max(0, len(batch) - 1).bit_length()),
-                    items=len(batch), tokens=len(batch),
-                )
+            generated = 0
+            prefill_fed = 0
             retired_this_step = 0
             emits = []
-            for sess, tok in zip(batch, next_tokens):
-                sess.pos += 1
-                sess.last_token = int(tok)
-                sess.out_tokens.append(int(tok))
-                emits.append(self._emit(sess, [int(tok)]))
+            for (sess, chunk, samples), tok in zip(rows, results):
+                if sess.prefilled:
+                    sess.pos += 1  # decode row: wrote its token at pos
+                else:
+                    sess.prefill_pos += chunk
+                    sess.pos = sess.prefill_pos
+                    prefill_fed += chunk
+                    self.stats.prefill_chunks += 1
+                if samples and tok is not None:
+                    t = int(tok)
+                    sess.last_token = t
+                    sess.out_tokens.append(t)
+                    generated += 1
+                    emits.append(self._emit(sess, [t]))
                 if sess.done or sess.cancelled:
                     retired_this_step += 1
                     self._retire(
                         sess,
                         error=SessionCancelled(sess.job_id) if sess.cancelled else None,
                     )
+            self.stats.steps += 1
+            self.stats.decoded_tokens += generated
+            self.stats.prefill_tokens += prefill_fed
+            self.stats.occupancy_sum += len(rows)
+            self.stats.max_occupancy = max(self.stats.max_occupancy, len(rows))
+            self.stats.step_seconds.append(dt)
+            if self.capacity is not None:
+                # one mixed step at the backend's static flat-buffer shape;
+                # warmup compiles are flagged so the steady-state tokens/s
+                # rows in the capacity matrix exclude them
+                self.capacity.observe(
+                    "llm.generate", device_s=dt,
+                    bucket=str(self.step_tokens),
+                    items=generated, tokens=generated,
+                    compiled=bool(getattr(self.backend, "last_step_compiled",
+                                          False)),
+                )
             if emits:
                 await asyncio.gather(*emits)
             if self.metrics is not None:
-                self.metrics.serving_batch_occupancy.observe(float(len(batch)))
+                self.metrics.serving_batch_occupancy.observe(float(len(rows)))
                 self.metrics.serving_inter_token.observe(dt)
             if step_span is not None and self.tracer is not None:
                 step_span.attrs["retired"] = str(retired_this_step)
+                step_span.attrs["prefill_tokens"] = str(prefill_fed)
                 step_span.attrs["step_ms"] = f"{dt * 1000:.2f}"
                 await self.tracer.finish(step_span)
             self._gauge()
@@ -463,10 +504,9 @@ class ServingEngine:
             if not sess.future.done():
                 sess.future.set_exception(SessionCancelled(sess.job_id))
         self._pending.clear()
-        for sess in [*self._prefilling.values(), *self._active.values()]:
+        for sess in list(self._active.values()):
             sess.cancelled = True
             self._retire(sess, error=SessionCancelled(sess.job_id))
-        self._prefilling.clear()
         if self._loop_task is not None:
             self._loop_task.cancel()
             try:
@@ -476,5 +516,3 @@ class ServingEngine:
             except Exception as e:  # noqa: BLE001 - logged, never swallowed
                 logx.warn("decode loop crashed during shutdown", err=str(e))
             self._loop_task = None
-        for t in list(self._prefill_tasks):
-            t.cancel()

@@ -405,12 +405,18 @@ def make_serving_engine(
     page_size: int = 16,
     max_sessions: int = 8,
     max_new_tokens: int = 64,
-    max_concurrent_prefills: int = 1,
+    max_concurrent_prefills: int = 2,
+    prefill_budget: int = 16,
     metrics=None,
 ):
     """Build the worker's continuous-batching serving engine over a paged
     Llama backend that shares ``compute``'s model params (one copy of the
     weights per worker process; the KV page arena is the serving addition).
+
+    The backend's static ragged-step shapes are sized here: ``max_sessions``
+    sequence rows over a flat token buffer of ``max_sessions +
+    prefill_budget`` slots, so a full decode set always fits and prefill
+    chunks ride the remaining ``prefill_budget`` tokens per step.
     """
     from ..serving.backend import LlamaServingBackend
     from ..serving.engine import ServingEngine
@@ -423,7 +429,10 @@ def make_serving_engine(
         compute.llama_cfg,
         num_pages=cache_pages,
         page_size=page_size,
+        max_seqs=max_sessions,
+        max_batch_tokens=max_sessions + max(1, prefill_budget),
         params_provider=params_provider,
+        metrics=metrics,
     )
     return ServingEngine(
         backend,
@@ -449,6 +458,7 @@ def attach_default_tpu_worker(
     serving_page_size: int = 16,
     serving_max_sessions: int = 8,
     serving_max_new_tokens: int = 64,
+    serving_prefill_budget: int = 16,
     metrics=None,
     **kw,
 ) -> TPUCompute:
@@ -469,6 +479,7 @@ def attach_default_tpu_worker(
             cache_pages=serving_cache_pages, page_size=serving_page_size,
             max_sessions=serving_max_sessions,
             max_new_tokens=serving_max_new_tokens,
+            prefill_budget=serving_prefill_budget,
             metrics=metrics,
         ))
     return compute

@@ -494,14 +494,16 @@ async def test_worker_failed_jobs_do_not_pollute_capacity():
     await eng.stop()
 
 
-async def test_serving_decode_steps_feed_capacity_profiler():
-    """Every ragged decode step reports its delivered tokens at the pow2
-    batch bucket, so the matrix carries decode tokens/s per worker."""
+async def test_serving_steps_feed_capacity_profiler():
+    """Every ragged mixed step reports its delivered tokens at the static
+    flat-buffer bucket — ONE row per worker, not a pow2 ladder — with the
+    warmup compile flagged so steady-state tokens/s excludes it."""
     from cordum_tpu.serving.engine import GenRequest, ServingEngine
     from tests.test_serving import FakeBackend, run_blocking
 
     cap = CapacityProfiler("cpu")
-    eng = ServingEngine(FakeBackend(num_pages=64), run_blocking=run_blocking,
+    be = FakeBackend(num_pages=64)
+    eng = ServingEngine(be, run_blocking=run_blocking,
                         max_sessions=4, capacity=cap)
     await asyncio.gather(*(
         eng.submit(GenRequest(prompt=[1, 2, 3], max_new_tokens=5,
@@ -510,13 +512,16 @@ async def test_serving_decode_steps_feed_capacity_profiler():
     ))
     await eng.stop()
     rows = [r for r in cap.rows() if r["op"] == "llm.generate"]
-    assert rows
-    # 3 sessions x 4 decoded tokens (the first token of each comes from
-    # prefill), spread over the pow2 batch buckets the ragged joins hit
-    assert sum(r["tokens"] for r in rows) == 12
-    assert all(r["items"] == r["tokens"] and r["tokens_per_s"] > 0
-               for r in rows)
-    assert {r["bucket"] for r in rows} <= {"1", "2", "4"}
+    # one static shape -> one (op, bucket) row at the flat-buffer width
+    assert [r["bucket"] for r in rows] == [str(be.max_batch_tokens)]
+    row = rows[0]
+    # 3 sessions x 5 generated tokens (the first token of each comes from
+    # its prefill-completing chunk, which now rides the same mixed step)
+    assert row["tokens"] == 15 and row["items"] == row["tokens"]
+    assert row["tokens_per_s"] > 0
+    # the fake's first step is its "compile"; the split keeps it out of
+    # the steady-state rate the fleet matrix reports
+    assert row["compile_n"] == 1 and row["n"] > row["compile_n"]
 
 
 # ---------------------------------------------------------------------------

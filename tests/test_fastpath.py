@@ -292,7 +292,8 @@ def test_floor_checker_passes_healthy_doc():
            "statebus_pipeline_speedup": 1.9,
            "sharded_jobs_per_sec": 300.0, "sharded_single_jobs_per_sec": 320.0,
            "serving_speedup": 4.5, "serving_affinity_hit_rate": 1.0,
-           "decode_tokens_per_sec": 2900.0,
+           "decode_tokens_per_sec": 2900.0, "serving_compile_count": 1,
+           "inter_token_p99_ms": 4.0,
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4}
@@ -310,7 +311,8 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "statebus_pipeline_speedup": 1.9,
            "sharded_jobs_per_sec": 300.0, "sharded_single_jobs_per_sec": 320.0,
            "serving_speedup": 4.5, "serving_affinity_hit_rate": 1.0,
-           "decode_tokens_per_sec": 2900.0,
+           "decode_tokens_per_sec": 2900.0, "serving_compile_count": 1,
+           "inter_token_p99_ms": 4.0,
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4}
@@ -320,6 +322,10 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
     doc["value"] = 2600.0
     doc["kv_roundtrips_per_job"] = 49.0
     assert any("kv_roundtrips_per_job" in v for v in mod.check(doc, floors))
+    # ... and the bucket-recompile cliff coming back is a gated failure
+    doc["kv_roundtrips_per_job"] = 3.0
+    doc["serving_compile_count"] = 6  # the old bucketed backend's count
+    assert any("serving_compile_count" in v for v in mod.check(doc, floors))
     # end-to-end: main() exits nonzero on a regressed artifact
     bench_json = tmp_path / "bench.json"
     doc["value"] = 100.0

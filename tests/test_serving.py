@@ -178,33 +178,158 @@ def test_page_reuse_never_leaks_across_sessions(llama_env):
     assert paged_greedy(be, alloc, "A2", [11, 22, 33, 44, 55, 66], 8) == a_out
 
 
+def test_ragged_mixed_prefill_decode_matches_sequential_property(llama_env):
+    """Property (ISSUE 11): a randomized schedule of mixed prefill chunks +
+    decode steps through the single ragged entry point is logit-identical
+    (fp32 argmax) to per-session sequential prefill + padded decode —
+    across varying prompt lengths (incl. multi-page), random chunk splits,
+    and sessions joining and leaving mid-stream."""
+    from cordum_tpu.serving.backend import StepEntry
+
+    cfg, params, be = llama_env
+    rng = random.Random(11)
+    alloc = PageAllocator(be.num_pages, be.page_size)
+    specs = []
+    for i in range(6):
+        plen = rng.randint(1, 2 * be.page_size + 3)  # spans 1-3 pages
+        specs.append({
+            "key": f"p{i}",
+            "prompt": [rng.randrange(cfg.vocab_size) for _ in range(plen)],
+            "n_new": rng.randint(1, 5),
+        })
+    waiting = list(specs)
+    live: list[dict] = []
+    out: dict[str, list[int]] = {s["key"]: [] for s in specs}
+    guard = 0
+    while waiting or live:
+        guard += 1
+        assert guard < 500, "schedule failed to converge"
+        for _ in range(rng.randint(0, 2)):  # joins mid-stream
+            if not waiting:
+                break
+            s = dict(waiting.pop(0), fed=0, pos=0, last=None)
+            total = len(s["prompt"]) + s["n_new"]
+            s["pages"] = alloc.alloc(s["key"], alloc.pages_for(total))
+            live.append(s)
+        if not live:
+            continue
+        entries, rows = [], []
+        budget = be.max_batch_tokens
+        for s in live:
+            if budget <= 0:
+                break
+            if s["fed"] < len(s["prompt"]):  # prefill chunk, random split
+                chunk = min(budget, rng.randint(1, len(s["prompt"]) - s["fed"]))
+                completes = s["fed"] + chunk == len(s["prompt"])
+                entries.append(StepEntry(
+                    tokens=s["prompt"][s["fed"]:s["fed"] + chunk],
+                    start=s["fed"], pages=s["pages"], sample=completes,
+                    phase="prefill", key=s["key"]))
+                s["fed"] += chunk
+                budget -= chunk
+            else:  # decode row
+                entries.append(StepEntry(
+                    tokens=[s["last"]], start=s["pos"], pages=s["pages"],
+                    sample=True, phase="decode", key=s["key"]))
+                budget -= 1
+            rows.append(s)
+        for s, tok in zip(rows, be.step(entries)):
+            if tok is None:
+                continue  # mid-prompt chunk
+            if s["last"] is None:  # prefill completion: the first token
+                s["pos"] = len(s["prompt"])
+            else:
+                s["pos"] += 1
+            s["last"] = int(tok)
+            out[s["key"]].append(int(tok))
+        for s in [s for s in live if len(out[s["key"]]) >= s["n_new"]]:
+            live.remove(s)  # leaves mid-stream free pages for reuse
+            alloc.free(s["key"])
+    for s in specs:
+        assert out[s["key"]] == ref_greedy(cfg, params, s["prompt"],
+                                           s["n_new"]), s["key"]
+
+
+def test_ragged_single_program_no_recompile_cliff(llama_env):
+    """Any mix of prompt lengths, batch widths and join/leave patterns
+    compiles exactly ONE XLA program — the bucket-recompile cliff is gone,
+    and ``cordum_serving_compile_total`` is the gated proof."""
+    from cordum_tpu.infra.metrics import Metrics
+    from cordum_tpu.serving.backend import LlamaServingBackend
+
+    cfg, params, _ = llama_env
+    metrics = Metrics()
+    be = LlamaServingBackend(cfg, num_pages=64, page_size=8,
+                             params_provider=lambda: params, metrics=metrics)
+    alloc = PageAllocator(be.num_pages, be.page_size)
+    # the old backend compiled one program per prompt-length bucket plus
+    # one per pow2 decode-batch bucket; this mix would have cost >= 6
+    sessions = []
+    for i, plen in enumerate((1, 3, 9, 17)):
+        prompt = [(7 * i + j) % cfg.vocab_size for j in range(plen)]
+        pages = alloc.alloc(f"c{i}", alloc.pages_for(plen + 4))
+        first = be.prefill(prompt, pages)
+        sessions.append((first, plen, pages))
+    for width in (1, 2, 4, 3):  # ragged join/leave widths, incl. non-pow2
+        be.decode([(t, p, pg) for t, p, pg in sessions[:width]])
+    assert be.compiled_programs() == 1
+    assert metrics.serving_compiles.value(entry="ragged") == 1
+    assert be.last_step_compiled is False  # steady state by now
+
+
 # -------------------------------------------- engine (fake backend, fast)
 
 
 class FakeBackend:
-    """Deterministic integer-arithmetic backend: next = (last * 3 + pos) %
-    251.  Tracks per-call batch sizes and supports an optional decode
-    delay so cancel tests get a window."""
+    """Deterministic integer-arithmetic backend implementing the ragged
+    ``step()`` interface: prefill chunks accumulate a per-session prompt
+    sum, the completing chunk samples ``(sum(prompt) * 3 + len(prompt)) %
+    251``, and a decode row samples ``(last * 3 + pos) % 251``.  Tracks
+    per-step row counts and supports an optional step delay so cancel
+    tests get a window."""
 
-    def __init__(self, num_pages=16, page_size=4, max_context=64, step_delay=0.0):
+    def __init__(self, num_pages=16, page_size=4, max_context=64,
+                 step_delay=0.0, max_seqs=16, max_batch_tokens=32):
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_context = max_context
+        self.max_seqs = max_seqs
+        self.max_batch_tokens = max_batch_tokens
         self.step_delay = step_delay
-        self.decode_batches: list[int] = []
-        self.prefills = 0
+        self.steps = 0
+        self.decode_batches: list[int] = []  # rows per mixed step
+        self.prefills = 0  # completed prompts
+        self.prefill_chunks = 0
+        self.last_step_compiled = False
+        self._fed: dict[str, tuple[int, int]] = {}  # key -> (sum, count)
 
-    def prefill(self, prompt, pages):
-        self.prefills += 1
-        return (sum(prompt) * 3 + len(prompt)) % 251
-
-    def decode(self, entries):
+    def step(self, entries):
         import time as _t
 
         if self.step_delay:
             _t.sleep(self.step_delay)
+        # the static-shape contract the real backend enforces
+        assert len(entries) <= self.max_seqs, "max_seqs exceeded"
+        assert sum(len(e.tokens) for e in entries) <= self.max_batch_tokens, \
+            "flat token budget exceeded"
+        self.last_step_compiled = self.steps == 0  # one program, one compile
+        self.steps += 1
         self.decode_batches.append(len(entries))
-        return [(tok * 3 + pos) % 251 for tok, pos, _pages in entries]
+        out = []
+        for e in entries:
+            if e.phase == "prefill":
+                s, c = self._fed.get(e.key, (0, 0))
+                s, c = s + sum(e.tokens), c + len(e.tokens)
+                self._fed[e.key] = (s, c)
+                self.prefill_chunks += 1
+                if e.sample:
+                    self.prefills += 1
+                    out.append((s * 3 + c) % 251)
+                else:
+                    out.append(None)
+            else:
+                out.append((e.tokens[0] * 3 + e.start) % 251)
+        return out
 
 
 def fake_ref(prompt, n_new):
@@ -248,6 +373,38 @@ async def test_engine_join_leave_matches_sequential():
     assert max(be.decode_batches) >= 2, "sessions never actually shared a step"
     assert eng.allocator.free_pages == eng.allocator.capacity  # all freed
     assert eng.stats.retired == 4 and eng.stats.failed == 0
+    await eng.stop()
+
+
+async def test_engine_chunked_prefill_rides_decode_steps():
+    """A prompt longer than the flat-buffer budget prefills in chunks
+    across several mixed steps while another session keeps decoding — both
+    finish with exactly their sequential tokens (chunked prefill is a
+    scheduling change, not a math change)."""
+    be = FakeBackend(num_pages=64, page_size=4, max_context=128,
+                     max_batch_tokens=8, step_delay=0.002)
+    eng = ServingEngine(be, run_blocking=run_blocking, max_sessions=4,
+                        max_new_tokens_cap=64)
+    long_prompt = list(range(1, 31))  # 30 tokens >> the 8-token budget
+
+    async def one(job_id, prompt, n_new, delay):
+        await asyncio.sleep(delay)
+        return await eng.submit(
+            GenRequest(prompt=prompt, max_new_tokens=n_new, stream=False),
+            job_id=job_id,
+        )
+
+    outs = await asyncio.wait_for(asyncio.gather(
+        one("fast", [2, 3], 20, 0.0),
+        one("slow", long_prompt, 4, 0.01),
+    ), timeout=20)
+    assert outs[0]["tokens"] == fake_ref([2, 3], 20)
+    assert outs[1]["tokens"] == fake_ref(long_prompt, 4)
+    # the long prompt really was chunked: sharing the 8-slot buffer with a
+    # decode row leaves <= 7 tokens per chunk, so 30 tokens need >= 5
+    assert be.prefill_chunks >= 5
+    assert eng.stats.prefill_tokens == 30 + 2
+    assert max(be.decode_batches) >= 2, "prefill never rode a decode step"
     await eng.stop()
 
 

@@ -22,6 +22,12 @@ TPU worker as separate OS processes, then over plain HTTP:
      every op the run executed, the fleet exposition carries an e2e
      exemplar resolving to a stored trace, and `cordumctl capacity` +
      `cordum traces blame` render
+ 10. ragged serving: llm.generate sessions with different prompt lengths
+     decode through the worker's single ragged mixed prefill+decode entry
+     point — `cordum_serving_compile_total{entry="ragged"}` reports
+     exactly 1 compiled program, and the capacity matrix's llm.generate
+     row carries the warmup compile in its compile split so the
+     steady-state tokens/s excludes it
 
 Exit 0 = PASS.  Usage: python tools/platform_smoke.py [--keep]
 """
@@ -482,6 +488,56 @@ def main() -> int:
                 f"e2e exemplar {m.group(1)[:8]} resolves "
                 f"({ex_trace['span_count']} spans), blame shares sum to "
                 f"{share_sum:.3f}; cordumctl capacity + traces blame render")
+
+            # 10. ragged serving: mixed-length llm.generate sessions through
+            # the single ragged entry point — one compiled XLA program for
+            # the whole mix (no prompt-length/batch buckets), and the
+            # capacity matrix's steady-state decode rate excludes the
+            # warmup compile via the compile split
+            gen_docs = []
+            for i, plen in enumerate((3, 7, 12)):  # different "buckets"
+                r = c.post("/api/v1/jobs", json={
+                    "topic": "job.tpu.generate",
+                    "payload": {"op": "llm.generate",
+                                "tokens": list(range(1, plen + 1)),
+                                "max_new_tokens": 8,
+                                "session_id": f"smoke-conv-{i}"}})
+                assert r.status_code == 202, r.text
+                gen_docs.append(r.json())
+            results = [wait_job(c, d["job_id"], "SUCCEEDED") for d in gen_docs]
+            for d in results:
+                assert len(d["result"]["tokens"]) == 8, d["result"]
+            # the whole mixed run compiled exactly ONE serving program
+            compile_lines = {}
+            srv_row = {}
+            t0 = time.time()
+            while time.time() - t0 < 45:
+                fleet_text = httpx.get(f"{API}/metrics?scope=fleet",
+                                       timeout=10.0).text
+                compile_lines = {
+                    ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+                    for ln in fleet_text.splitlines()
+                    if ln.startswith("cordum_serving_compile_total{")
+                }
+                cap = c.get("/api/v1/capacity").json()
+                srv_row = next((r for r in cap.get("matrix", [])
+                                if r["op"] == "llm.generate"), {})
+                if compile_lines and srv_row.get("tokens_per_s", 0) > 0:
+                    break
+                time.sleep(1.0)
+            ragged = [v for k, v in compile_lines.items()
+                      if 'entry="ragged"' in k]
+            assert ragged == [1.0], (
+                f"expected exactly one ragged compile: {compile_lines}")
+            # the warmup compile rides the capacity row's compile split, so
+            # the steady-state rate the matrix reports excludes it
+            assert srv_row.get("compile_n", 0) >= 1, srv_row
+            assert srv_row.get("n", 0) > srv_row["compile_n"], srv_row
+            assert srv_row.get("tokens_per_s", 0) > 0, srv_row
+            log(f"10. ragged serving: 3 mixed-length sessions decoded, "
+                f"1 compiled program, capacity row steady tokens/s="
+                f"{srv_row['tokens_per_s']} (compile_n={srv_row['compile_n']} "
+                f"of n={srv_row['n']} excluded)")
 
         log("PASS")
         return 0
