@@ -353,6 +353,30 @@ def cmd_capacity(args) -> None:
     print(render_capacity_table(doc))
 
 
+def cmd_drain(args) -> None:
+    """Gracefully drain a worker: sessions live-migrate to peers (scheduler
+    requeue as the fallback), per-job work finishes, then it exits —
+    zero CANCELLED sessions (docs/SERVING.md §Migration)."""
+    with _client() as c:
+        doc = _check(c.post(f"/api/v1/workers/{args.worker_id}/drain",
+                            json={"reason": args.reason} if args.reason else {}))
+        _print(doc)
+        if not args.wait:
+            return
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            workers = _check(c.get("/api/v1/workers")).get("workers", {})
+            hb = workers.get(args.worker_id)
+            if hb is None:
+                print(f"worker {args.worker_id} drained (deregistered)")
+                return
+            if hb.get("draining"):
+                print(f"worker {args.worker_id} draining "
+                      f"(active_jobs={hb.get('active_jobs', '?')})")
+            time.sleep(1.0)
+        _die(f"worker {args.worker_id} still registered after {args.timeout}s")
+
+
 def cmd_pack(args) -> None:
     from .packs import cli_pack
 
@@ -523,6 +547,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "'|' = replica set)")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_statebus)
+
+    sp = sub.add_parser(
+        "drain",
+        help="gracefully drain a worker (live-migrate its serving sessions "
+             "to peers, finish jobs, exit)")
+    sp.add_argument("worker_id")
+    sp.add_argument("--reason", default="")
+    sp.add_argument("--wait", action="store_true",
+                    help="poll /api/v1/workers until the worker deregisters")
+    sp.add_argument("--timeout", type=float, default=120.0)
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("pack")
     sp.add_argument("action", choices=["create", "install", "uninstall", "list", "show", "verify"])

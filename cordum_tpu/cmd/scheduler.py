@@ -20,7 +20,11 @@ from ..controlplane.safetykernel.kernel import SafetyKernel
 from ..controlplane.safetykernel.service import remote_check
 from ..controlplane.scheduler.engine import Engine
 from ..controlplane.scheduler.overlay import ConfigOverlay, WorkerSnapshotWriter
-from ..controlplane.scheduler.reconciler import PendingReplayer, Reconciler
+from ..controlplane.scheduler.reconciler import (
+    PendingReplayer,
+    Reconciler,
+    WorkerFailover,
+)
 from ..controlplane.scheduler.safety_client import SafetyClient
 from ..controlplane.scheduler.strategy import LeastLoadedStrategy
 from ..infra import logging as logx
@@ -49,7 +53,12 @@ async def main() -> None:
     kv, bus, conn = await _boot.connect_statebus(cfg)
     job_store = JobStore(kv)
     configsvc = ConfigService(kv)
-    registry = WorkerRegistry()
+    # SCHEDULER_REGISTRY_TTL bounds dead-worker detection: a worker whose
+    # heartbeats stop for this long is expired and its in-flight jobs fail
+    # over (WorkerFailover)
+    registry = WorkerRegistry(
+        ttl_s=_boot.env_float("SCHEDULER_REGISTRY_TTL", 30.0)
+    )
 
     pool_cfg = load_pool_config(cfg.pool_config_path)
     timeouts = load_timeouts(cfg.timeout_config_path)
@@ -86,6 +95,10 @@ async def main() -> None:
     )
     reconciler = Reconciler(job_store, timeouts, instance_id=engine.instance_id)
     replayer = PendingReplayer(engine, job_store, timeouts)
+    # serving-session crash failover: dead workers' in-flight jobs are
+    # re-dispatched (with the streamed-token resume prefix) instead of
+    # waiting out the running timeout (docs/SERVING.md)
+    failover = WorkerFailover(engine, job_store, registry, timeouts)
 
     # fleet telemetry plane (docs/OBSERVABILITY.md §Fleet telemetry): this
     # shard's registry + a health beacon carrying its shard coordinates and
@@ -132,6 +145,7 @@ async def main() -> None:
     await engine.start()
     await reconciler.start()
     await replayer.start()
+    await failover.start()
     await overlay.start()
     await snapshotter.start()
     await telemetry.start()
@@ -145,6 +159,7 @@ async def main() -> None:
         await telemetry.stop()
         await snapshotter.stop()
         await overlay.stop()
+        await failover.stop()
         await replayer.stop()
         await reconciler.stop()
         await engine.stop()

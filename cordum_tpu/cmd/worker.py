@@ -17,11 +17,20 @@ the ragged step's chunked-prefill token budget, overridable via
 WORKER_SERVING_CACHE_PAGES / WORKER_SERVING_PAGE_SIZE /
 WORKER_SERVING_MAX_SESSIONS / WORKER_SERVING_MAX_NEW_TOKENS /
 WORKER_SERVING_PREFILL_BUDGET, and WORKER_SERVING=0 disables the engine.
+
+Graceful drain (docs/SERVING.md §Migration, drain, and failover): SIGTERM
+(unless WORKER_DRAIN_ON_TERM=0) and ``cordumctl drain <worker>`` both put
+the worker in drain mode — stop admitting, live-migrate serving sessions
+to peers, finish per-job work (WORKER_DRAIN_TIMEOUT, default 30s), then
+exit with zero CANCELLED sessions.  WORKER_LLAMA_DTYPE (float32|bfloat16)
+overrides the tiny model's dtype — the chaos suite pins float32 so resumed
+token streams compare exactly against the fp32 sequential oracle.
 """
 from __future__ import annotations
 
 import asyncio
 import os
+import signal
 
 if os.environ.get("CORDUM_FORCE_CPU") == "1":
     # neutralize the axon sitecustomize platform override BEFORE any jax
@@ -76,9 +85,23 @@ async def main() -> None:
     # one registry shared by the batcher, the serving engine and the fleet
     # telemetry exporter, so worker-side metrics reach the aggregator
     metrics = Metrics()
+    extra_kw = {}
+    dtype_name = env.get("WORKER_LLAMA_DTYPE", "")
+    if dtype_name in ("float32", "bfloat16"):
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from ..models import llama
+
+        extra_kw["llama_cfg"] = dataclasses.replace(
+            llama.LlamaConfig.tiny(),
+            dtype=jnp.float32 if dtype_name == "float32" else jnp.bfloat16,
+        )
     attach_default_tpu_worker(
         worker,
         metrics=metrics,
+        **extra_kw,
         tp=_boot.env_int("WORKER_TP", 1),
         batching=env.get("WORKER_BATCHING", "1") != "0",
         max_batch_rows=_boot.env_int("WORKER_MAX_BATCH_SIZE", 0)
@@ -105,8 +128,32 @@ async def main() -> None:
     await worker.start()
     await telemetry.start()
     await profiler.start()
+    # SIGTERM drains by default (live-migrate sessions, finish jobs, exit);
+    # SIGINT stays the immediate-stop path.  A `cordumctl drain` arriving
+    # over the bus completes the same drained event.
+    stop = asyncio.Event()
+    drain_timeout = _boot.env_float("WORKER_DRAIN_TIMEOUT", 30.0)
+
+    def _on_term() -> None:
+        if env.get("WORKER_DRAIN_ON_TERM", "1") != "0":
+            asyncio.ensure_future(worker.drain(timeout_s=drain_timeout))
+        else:
+            stop.set()
+
+    loop = asyncio.get_running_loop()
     try:
-        await _boot.wait_for_shutdown()
+        loop.add_signal_handler(signal.SIGTERM, _on_term)
+        loop.add_signal_handler(signal.SIGINT, stop.set)
+    except NotImplementedError:  # pragma: no cover - non-unix
+        pass
+    try:
+        stop_w = asyncio.ensure_future(stop.wait())
+        drained_w = asyncio.ensure_future(worker.wait_drained())
+        done, pending = await asyncio.wait(
+            {stop_w, drained_w}, return_when=asyncio.FIRST_COMPLETED
+        )
+        for t in pending:
+            t.cancel()
     finally:
         await profiler.stop()
         await telemetry.stop()

@@ -69,6 +69,7 @@ from ...protocol.types import (
     LABEL_SESSION_KEY,
     PolicyCheckRequest,
     TERMINAL_STATES,
+    WorkerDrain,
     payload_batch_key,
     payload_session_key,
 )
@@ -248,6 +249,7 @@ class Gateway:
         r.add_get(f"{v1}/fleet", self.get_fleet)
         r.add_get(f"{v1}/capacity", self.get_capacity)
         r.add_get(f"{v1}/workers", self.get_workers)
+        r.add_post(f"{v1}/workers/{{worker_id}}/drain", self.drain_worker)
         r.add_get(f"{v1}/status", self.get_status)
         r.add_get(f"{v1}/stream", self.ws_stream)
         r.add_get("/healthz", self.healthz)
@@ -1298,6 +1300,36 @@ class Gateway:
             return web.json_response(self.registry.snapshot_json())
         snap = await self.kv.get("sys:workers:snapshot")
         return web.json_response(json.loads(snap) if snap else {"workers": {}, "count": 0})
+
+    async def drain_worker(self, request: web.Request) -> web.Response:
+        """``POST /api/v1/workers/{worker_id}/drain`` — ask a worker to
+        drain gracefully: stop admitting, live-migrate its serving sessions
+        to peers, finish per-job work, then exit (docs/SERVING.md
+        §Migration, drain, and failover).  Fire-and-forget: the drain
+        request fans out on the bus and progress shows up as the worker's
+        ``draining`` heartbeat and its fleet beacon."""
+        principal: Principal = request["principal"]
+        worker_id = request.match_info["worker_id"]
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:  # noqa: BLE001 - body is optional
+                body = {}
+        await self.bus.publish(
+            subj.DRAIN,
+            BusPacket.wrap(
+                WorkerDrain(
+                    worker_id=worker_id,
+                    reason=str((body or {}).get("reason", "api drain")),
+                    requested_by=principal.principal_id,
+                ),
+                sender_id=self.instance_id,
+            ),
+        )
+        return web.json_response(
+            {"worker_id": worker_id, "draining": True}, status=202
+        )
 
     async def get_status(self, request: web.Request) -> web.Response:
         return web.json_response({

@@ -47,11 +47,13 @@ from ...protocol.types import (
     Constraints,
     Decision,
     ENV_EFFECTIVE_CONFIG,
+    ERROR_SESSION_REQUEUE,
     JobRequest,
     JobResult,
     JobState,
     LABEL_APPROVAL_GRANTED,
     LABEL_PARTITION,
+    LABEL_RESUME_TOKENS,
     PolicyCheckRequest,
     STATUS_HINT_STREAM,
     TERMINAL_STATES,
@@ -188,6 +190,14 @@ class Engine:
         # MetaSnapshot, so the result path needs ZERO reads in the common
         # case (a conflict — e.g. a cancel racing the result — re-reads)
         self._snap_cache: dict[str, MetaSnapshot] = {}
+        # serving failover (docs/SERVING.md §Migration, drain, and
+        # failover): the owner shard shadows each live session's streamed
+        # tokens in memory (offset-merged from stream progress packets) so
+        # a crash re-dispatch can stamp them as the forced-decode resume
+        # prefix.  Deliberately NOT persisted — per-token writes would
+        # swamp the job store; after a scheduler restart a failover simply
+        # replays from the prompt (same tokens, more decode work).
+        self._stream_tokens: dict[str, list[int]] = {}
         # kv round-trip accounting (cordum_kv_roundtrips_total{op}) for the
         # store this engine drives — the bench's kv_roundtrips_per_job source
         job_store.kv.bind_metrics(self.metrics)
@@ -239,6 +249,7 @@ class Engine:
         self._submit_q = []
         self._result_q = []
         self._snap_cache.clear()
+        self._stream_tokens.clear()
 
     # ------------------------------------------------------------------
     def owns(self, job_id: str) -> bool:
@@ -258,10 +269,22 @@ class Engine:
         hb = pkt.heartbeat
         if hb is None:
             return
-        self.registry.update(hb)
+        if hb.draining and hb.worker_id:
+            # drain beacon: deregister on sight and drop every affinity
+            # entry pointing at the worker — new session/batch jobs must
+            # not route to a worker that is migrating its state away
+            self.registry.remove(hb.worker_id)
+            self._evict_affinity(hb.worker_id)
+        else:
+            self.registry.update(hb)
         self.metrics.workers_live.set(len(self.registry.snapshot()))
         if hb.worker_id:
             self.metrics.tpu_duty_cycle.set(hb.tpu_duty_cycle, worker=hb.worker_id)
+
+    def _evict_affinity(self, worker_id: str) -> None:
+        evict = getattr(self.strategy, "evict_worker", None)
+        if evict is not None:
+            evict(worker_id)
 
     async def _on_progress(self, subject: str, pkt: BusPacket) -> None:
         pr = pkt.job_progress
@@ -271,13 +294,33 @@ class Engine:
             # llm.generate token-stream packets are transport, not state:
             # the gateway WS tap relays them live and the terminal result
             # carries the full token list — persisting one event per decode
-            # step would swamp the job store
+            # step would swamp the job store.  The owner shard DOES shadow
+            # them in memory: they become the forced-decode resume prefix
+            # when the worker dies mid-session (failover_job).
+            if pr.tokens and self.owns(pr.job_id):
+                self._record_stream(pr.job_id, pr.offset, pr.tokens)
             return
         if not self.owns(pr.job_id):
             return  # progress fans out to every shard; only the owner records
         await self.job_store.append_event(
             pr.job_id, "progress", percent=pr.percent, message=pr.message
         )
+
+    def _record_stream(self, job_id: str, offset: int, tokens: list) -> None:
+        buf = self._stream_tokens.get(job_id)
+        if buf is None:
+            if len(self._stream_tokens) > 8192:
+                self._stream_tokens.clear()  # leak guard (entries pop on terminal)
+            buf = self._stream_tokens[job_id] = []
+        off = offset if isinstance(offset, int) and offset >= 0 else len(buf)
+        for i, t in enumerate(tokens):
+            idx = off + i
+            if idx == len(buf):
+                buf.append(int(t))
+            elif idx < len(buf):
+                buf[idx] = int(t)
+            # idx > len(buf): a gap (lost packet) — the worker's resume
+            # replay at offset 0 backfills it on the next failover
 
     async def _on_cancel(self, subject: str, pkt: BusPacket) -> None:
         c = pkt.job_cancel
@@ -720,6 +763,7 @@ class Engine:
                 # re-read raised IllegalTransition — the per-job path raises
                 # the same way); its future already carries the error
                 continue
+            self._stream_tokens.pop(it.res.job_id, None)
             self.metrics.jobs_completed.inc(status=it.state.value)
             klass = it.snap.get("priority", "") or "BATCH"
             self.metrics.jobs_by_class.inc(job_class=klass, status=it.state.value)
@@ -1098,6 +1142,78 @@ class Engine:
         self.metrics.inflight_nudges.inc()
         return True
 
+    async def failover_job(self, job_id: str, *, reason: str = "worker_dead") -> bool:
+        """Re-dispatch an in-flight job to a NEW worker after its old one
+        died or handed it back (``SESSION_REQUEUE``) — the serving-session
+        crash-failover leg (docs/SERVING.md §Migration, drain, and
+        failover).  Differences from :meth:`nudge_inflight`: the strategy
+        picks a FRESH target (the dead worker's affinity entries are
+        evicted first), the attempt counts against the job's budget (past
+        the cap it fails to the DLQ), and any tokens the dead worker
+        already streamed ride along as the forced-decode resume prefix so
+        the client's stream resumes with no duplicated or missing tokens.
+        State stays DISPATCHED/RUNNING throughout — legal, since the job
+        really is still in flight."""
+        if not await self.job_store.acquire_job_lock(job_id, self.instance_id, ttl_s=30.0):
+            return False
+        try:
+            snap = await self.job_store.watch_meta(job_id)
+            if snap.state not in (JobState.DISPATCHED.value, JobState.RUNNING.value):
+                return False  # finished (or was cancelled) concurrently
+            req = await self.job_store.get_request(job_id)
+            if req is None:
+                return False
+            attempts = int(snap.get("attempts", "0") or "0") + 1
+            if attempts > self.max_attempts:
+                self._stream_tokens.pop(job_id, None)
+                await self._fail_to_dlq(
+                    req, f"failover attempts exhausted ({reason})",
+                    "MAX_RETRIES", fields={"attempts": str(attempts)},
+                    snap=snap,
+                )
+                return True
+            req.labels = dict(req.labels or {})
+            streamed = self._stream_tokens.get(job_id)
+            if streamed:
+                # the forced-decode prefix: the new worker prefills
+                # prompt + prefix, replays it at offset 0 (consumers
+                # dedupe), and generates only the remainder.  NOT persisted
+                # onto the stored request — the prefix is routing state,
+                # and mutating the blob would break approval hash checks.
+                req.labels[LABEL_RESUME_TOKENS] = ",".join(
+                    str(t) for t in streamed
+                )
+            target = self.strategy.pick_subject(req)
+            # attempts + the new dispatch subject land BEFORE the publish
+            # (idempotent same-state fields commit), so a crash loop still
+            # burns its budget and the replayer nudges the right worker
+            _, snap = await self.job_store.apply_chain(
+                job_id,
+                [(JobState(snap.state),
+                  {"attempts": str(attempts), "dispatch_subject": target},
+                  "")],
+                snap=snap,
+            )
+            req.labels["cordum.bus_msg_id"] = f"failover-{job_id}-{attempts}"
+            self._stamp_partition(req)
+            await self.bus.publish(
+                target,
+                BusPacket.wrap(req, trace_id=snap.get("trace_id", ""),
+                               sender_id=self.instance_id),
+            )
+            await self.job_store.append_event(
+                job_id, "failover", reason=reason, target=target,
+                attempts=attempts, resumed_tokens=len(streamed or ()),
+            )
+            self.metrics.session_failovers.inc(reason=reason)
+            self.metrics.jobs_dispatched.inc(topic=req.topic)
+            logx.info("job failed over", job_id=job_id, reason=reason,
+                      target=target, attempts=attempts,
+                      resumed_tokens=len(streamed or ()))
+            return True
+        finally:
+            await self.job_store.release_job_lock(job_id, self.instance_id)
+
     # ------------------------------------------------------------------
     async def _check_safety(self, req: JobRequest):
         """Approval-granted fast path with hash binding, else kernel check."""
@@ -1217,6 +1333,12 @@ class Engine:
         except ValueError:
             state = JobState.FAILED
         if state not in TERMINAL_STATES:
+            if res.error_code == ERROR_SESSION_REQUEUE:
+                # a worker handed the job back (drain without a migration
+                # target, crashed decode loop): re-dispatch it instead of
+                # recording anything terminal — bounded by the attempts cap
+                await self.failover_job(res.job_id, reason="requeue_requested")
+                return
             # workers may send RUNNING status hints; record as event only
             await self.job_store.append_event(res.job_id, "result_hint", status=res.status)
             return
@@ -1245,6 +1367,7 @@ class Engine:
         _, snap = await self.job_store.apply_chain(
             res.job_id, [(state, fields, "result")], snap=snap
         )
+        self._stream_tokens.pop(res.job_id, None)
         self.metrics.jobs_completed.inc(status=state.value)
         # SLO class = the persisted submit-time priority (obs/slo.py reads
         # the class-labeled series fleet-wide)

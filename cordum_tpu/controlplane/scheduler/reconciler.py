@@ -8,6 +8,12 @@ TIMEOUT past their configured timeouts (batched), and (b) expires
 Pending replayer (reference ``pending_replayer.go``): re-drives PENDING
 jobs older than the dispatch timeout through the engine using the persisted
 JobRequest — unsticks submits lost to crashes between persist and dispatch.
+
+Worker failover (docs/SERVING.md §Migration, drain, and failover): expires
+workers that missed heartbeats, evicts their affinity entries, and fails
+their in-flight jobs over to new workers — a SIGKILL'd serving worker's
+sessions resume elsewhere with a forced-decode resume prefix instead of
+timing out.
 """
 from __future__ import annotations
 
@@ -17,6 +23,8 @@ from typing import Optional
 from ...infra import logging as logx
 from ...infra.config import Timeouts
 from ...infra.jobstore import IllegalTransition, JobStore
+from ...infra.registry import WorkerRegistry
+from ...protocol.subjects import direct_subject
 from ...protocol.types import JobState
 from ...utils.ids import now_ms, now_us
 from .engine import Engine
@@ -121,6 +129,83 @@ class Reconciler:
                     n += 1
             except IllegalTransition:
                 pass
+        return n
+
+
+class WorkerFailover:
+    """Detects dead workers (missed heartbeats past the registry TTL) and
+    fails their in-flight jobs over to new workers.
+
+    Each pass expires the registry, evicts the dead workers' affinity
+    entries (so session turns stop routing at the corpse), then scans the
+    owner shard's DISPATCHED/RUNNING jobs for ones whose recorded
+    ``dispatch_subject`` targets a dead worker's direct subject and drives
+    :meth:`Engine.failover_job` for each — serving sessions resume on a new
+    worker with their streamed tokens as a forced-decode prefix; stateless
+    jobs simply re-run (worker idempotence dedupes the occasional race).
+    Per-shard, no singleton lock: each shard fails over only jobs it owns."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        job_store: JobStore,
+        registry: WorkerRegistry,
+        timeouts: Timeouts,
+    ) -> None:
+        self.engine = engine
+        self.job_store = job_store
+        self.registry = registry
+        self.timeouts = timeouts
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+
+    async def start(self) -> None:
+        self._stop.clear()
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task:
+            self._task.cancel()
+            await logx.join_task(self._task, name="worker-failover")
+            self._task = None
+
+    async def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.run_once()
+            except Exception:
+                logx.error("worker failover pass failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.timeouts.scan_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def run_once(self) -> int:
+        dead = self.registry.expire()
+        if not dead:
+            return 0
+        logx.warn("workers missed heartbeats; failing over their jobs",
+                  workers=",".join(dead))
+        for wid in dead:
+            self.engine._evict_affinity(wid)
+        dead_subjects = {direct_subject(w) for w in dead}
+        n = 0
+        for state in (JobState.DISPATCHED.value, JobState.RUNNING.value):
+            stuck = await self.job_store.list_by_state_older_than(
+                state, now_us(), BATCH
+            )
+            for job_id in stuck:
+                if not self.engine.owns(job_id):
+                    continue
+                snap = await self.job_store.watch_meta(job_id)
+                if snap.get("dispatch_subject", "") not in dead_subjects:
+                    continue
+                try:
+                    if await self.engine.failover_job(job_id, reason="worker_dead"):
+                        n += 1
+                except Exception:
+                    logx.warn("failover failed", job_id=job_id)
         return n
 
 

@@ -124,6 +124,8 @@ def worker_satisfies(
 def is_overloaded(hb: Heartbeat) -> bool:
     if not hb.devices_healthy:
         return True
+    if hb.draining:
+        return True  # drain mode: finishing/migrating work, no new placements
     if hb.max_parallel_jobs > 0 and hb.active_jobs >= OVERLOAD_FRACTION * hb.max_parallel_jobs:
         return True
     if hb.cpu_load >= OVERLOAD_UTIL or hb.tpu_duty_cycle >= OVERLOAD_UTIL:
@@ -156,6 +158,7 @@ class LeastLoadedStrategy(Strategy):
         self.session_affinity_hits = 0
         self.session_affinity_misses = 0
         self.session_affinity_new = 0
+        self.session_affinity_evicted = 0
         # routing caches (ISSUE 6): topic→pools and the native scan's
         # resolved arguments are identical for every job of one shape, so
         # re-deriving them per pick (regex parses, pool scans, ctypes array
@@ -197,6 +200,20 @@ class LeastLoadedStrategy(Strategy):
                 del self._affinity[k]
         self._affinity[key] = (worker_id, time.monotonic())
 
+    def evict_worker(self, worker_id: str) -> int:
+        """Invalidate every affinity entry (session AND batch) pointing at
+        ``worker_id`` — called when a worker deregisters, drains, or misses
+        heartbeats, so session turns stop routing to a dead/draining worker
+        for up to the 120s session TTL.  Returns the number of entries
+        dropped; session evictions count in
+        ``cordum_session_affinity_total{outcome="evicted"}``."""
+        dead = [k for k, (wid, _) in self._affinity.items() if wid == worker_id]
+        for k in dead:
+            del self._affinity[k]
+            if k.startswith(_SESSION_PREFIX):
+                self._count_session_affinity("evicted")
+        return len(dead)
+
     def _affinity_worker(
         self, key: str, pools: list[Pool], job_requires: list[str],
         placement: dict[str, str], ttl_s: float = BATCH_AFFINITY_TTL_S,
@@ -214,7 +231,15 @@ class LeastLoadedStrategy(Strategy):
             self._affinity.pop(key, None)
             return ""
         hb = self.registry.get(worker_id)
-        if hb is None or is_overloaded(hb):
+        if hb is None or hb.draining:
+            # missed-heartbeat / draining worker: drop the entry outright
+            # (lazy mirror of evict_worker) instead of leaving it to block
+            # the key until the TTL expires
+            self._affinity.pop(key, None)
+            if key.startswith(_SESSION_PREFIX):
+                self._count_session_affinity("evicted")
+            return ""
+        if is_overloaded(hb):
             return ""
         pool = next((p for p in pools if p.name == hb.pool), None)
         if pool is None:
@@ -307,6 +332,8 @@ class LeastLoadedStrategy(Strategy):
             self.session_affinity_hits += 1
         elif outcome == "miss":
             self.session_affinity_misses += 1
+        elif outcome == "evicted":
+            self.session_affinity_evicted += 1
         else:
             self.session_affinity_new += 1
         if self.metrics is not None:

@@ -437,7 +437,29 @@ class Metrics:
         self.session_affinity = Counter(
             "cordum_session_affinity_total",
             "Session-keyed routing outcomes (hit = routed to the worker "
-            "holding the session's KV pages)",
+            "holding the session's KV pages; evicted = the entry was "
+            "invalidated because its worker deregistered, drained, or "
+            "missed heartbeats)",
+        )
+        # serving session failover (docs/SERVING.md §Migration, drain, and
+        # failover): live KV-page migration between workers + scheduler-side
+        # session re-dispatch after worker death or a requeue request
+        self.serving_migrations = Counter(
+            "cordum_serving_migrations_total",
+            "Live KV-page session migrations, by role (out = this worker "
+            "shipped the session; in = adopted it) and outcome",
+        )
+        self.serving_migration_pause = Histogram(
+            "cordum_serving_migration_pause_seconds",
+            "Decode pause per migration (freeze -> target commit): only the "
+            "final freeze-and-delta chunk stops the session's tokens",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     2.5),
+        )
+        self.session_failovers = Counter(
+            "cordum_sched_session_failovers_total",
+            "In-flight jobs re-dispatched to a new worker, by reason "
+            "(worker_dead | requeue_requested)",
         )
         # fleet telemetry plane (cordum_tpu/obs, ISSUE 9): retention-cap
         # drops made observable, per-class SLO measurement, exporter flow,
@@ -527,6 +549,9 @@ class Metrics:
             self.serving_kv_pages_in_use,
             self.serving_compiles,
             self.session_affinity,
+            self.serving_migrations,
+            self.serving_migration_pause,
+            self.session_failovers,
             self.spans_dropped,
             self.telemetry_snapshots,
             self.telemetry_dropped,

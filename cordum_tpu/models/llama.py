@@ -259,6 +259,75 @@ def init_kv_pages(
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
 
+# One jitted program each for reading/writing a single arena page with the
+# page INDEX as a traced operand: every page of every migration reuses the
+# same two executables (a python-int index baked into an eager slice would
+# compile one executable per (page, length) pair — ~150ms per page hop).
+@jax.jit
+def _gather_page(pages: jax.Array, pid: jax.Array) -> jax.Array:
+    return jax.lax.dynamic_index_in_dim(pages, pid, axis=1, keepdims=False)
+
+
+@jax.jit
+def _scatter_page(pages: jax.Array, pid: jax.Array, block: jax.Array) -> jax.Array:
+    return jax.lax.dynamic_update_index_in_dim(pages, block, pid, axis=1)
+
+
+def gather_kv_pages(
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_ids: list[int],
+    used: list[int],
+) -> list[tuple[Any, Any]]:
+    """Read pages out of the arena at their TRUE lengths — the export half
+    of live KV-page migration (docs/PROTOCOL.md §Page transfer).
+
+    ``page_ids[i]`` is an arena page index and ``used[i]`` how many of its
+    ``page_size`` token slots hold live positions (only the sequence's last
+    page is partial).  The device read is always the full page (static
+    shape → one cached program); the trim to ``used`` happens host-side so
+    only live slots ride the wire.  Returns per-page ``(k, v)`` numpy
+    arrays of shape ``[L, used, kvh, hd]`` upcast to float32 — an exact
+    round trip for the bf16/fp32 arenas, and a wire format the receiver
+    can cast back without knowing the sender's dtype."""
+    import numpy as np
+
+    out = []
+    for pid, n in zip(page_ids, used):
+        k = np.asarray(_gather_page(k_pages, pid))[:, :n].astype(np.float32)
+        v = np.asarray(_gather_page(v_pages, pid))[:, :n].astype(np.float32)
+        out.append((k, v))
+    return out
+
+
+def scatter_kv_pages(
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_ids: list[int],
+    blocks: list[tuple[Any, Any]],
+) -> tuple[jax.Array, jax.Array]:
+    """Write migrated pages into the arena at their true lengths — the
+    import half of live KV-page migration.  ``blocks[i]`` is the
+    ``(k, v)`` pair :func:`gather_kv_pages` produced for ``page_ids[i]``.
+    Each write pads its block to the full page (static shape → one cached
+    program); slots past the true length are zero-filled, which is inert —
+    the causal mask makes unwritten positions unreachable, and the resumed
+    session overwrites them as it decodes.  Returns the updated arenas."""
+    import numpy as np
+
+    dt = k_pages.dtype
+    ps = k_pages.shape[2]
+    for pid, (k, v) in zip(page_ids, blocks):
+        n = k.shape[1]
+        if n < ps:
+            pad = [(0, 0), (0, ps - n), (0, 0), (0, 0)]
+            k = np.pad(np.asarray(k), pad)
+            v = np.pad(np.asarray(v), pad)
+        k_pages = _scatter_page(k_pages, pid, jnp.asarray(k, dt))
+        v_pages = _scatter_page(v_pages, pid, jnp.asarray(v, dt))
+    return k_pages, v_pages
+
+
 def ragged_step(
     params: Params,
     k_pages: jax.Array,

@@ -8,6 +8,10 @@ PROGRESS = "sys.job.progress"
 CANCEL = "sys.job.cancel"
 DLQ = "sys.job.dlq"
 WORKFLOW_EVENT = "sys.workflow.event"
+# graceful worker drain (docs/SERVING.md §Migration, drain, and failover):
+# fan-out — every worker hears it and the addressed one drains.  Not
+# durable: a drain request is an operator action, re-issued if lost.
+DRAIN = "sys.worker.drain"
 JOB_EVENTS_WILDCARD = "sys.job.>"  # every job lifecycle event (gateway tap)
 TRACE_SPAN = "sys.trace.span"  # finished flight-recorder spans → collector
 

@@ -311,6 +311,10 @@ class Heartbeat(WireModel):
     chip_count: int = 0
     slice_topology: str = ""  # e.g. "2x2x1"
     devices_healthy: bool = True
+    # graceful drain (docs/SERVING.md): a draining worker is finishing or
+    # migrating its work and must receive NO new placements — the scheduler
+    # deregisters it and evicts its session/batch affinity entries on sight
+    draining: bool = False
 
 
 @dataclass
@@ -327,11 +331,29 @@ class JobProgress(WireModel):
     # packets are transport, not state: the scheduler does not persist them
     # (the terminal JobResult carries the full list).
     tokens: list[int] = field(default_factory=list)
+    # token offset of ``tokens[0]`` within the session's full generation
+    # (-1 = unknown, legacy packets).  A failed-over session replays its
+    # already-streamed prefix, so stream consumers MUST dedupe by offset to
+    # assemble an exactly-once token sequence (docs/PROTOCOL.md).
+    offset: int = -1
 
 
 @dataclass
 class JobCancel(WireModel):
     job_id: str = ""
+    reason: str = ""
+    requested_by: str = ""
+
+
+@dataclass
+class WorkerDrain(WireModel):
+    """Graceful-drain request for one worker (``sys.worker.drain`` fan-out;
+    docs/SERVING.md §Migration, drain, and failover).  The addressed worker
+    stops admitting, live-migrates its serving sessions to peers, finishes
+    its per-job work, publishes a final ``draining`` heartbeat (which evicts
+    its scheduler affinity), then exits — zero CANCELLED sessions."""
+
+    worker_id: str = ""
     reason: str = ""
     requested_by: str = ""
 
@@ -469,6 +491,7 @@ _PAYLOAD_TYPES: dict[str, type] = {
     "heartbeat": Heartbeat,
     "job_progress": JobProgress,
     "job_cancel": JobCancel,
+    "worker_drain": WorkerDrain,
     "system_alert": SystemAlert,
     "span": Span,
     "telemetry": TelemetrySnapshot,
@@ -657,6 +680,10 @@ class BusPacket(WireModel):
         return self.payload if self.kind == "job_cancel" else None
 
     @property
+    def worker_drain(self) -> Optional[WorkerDrain]:
+        return self.payload if self.kind == "worker_drain" else None
+
+    @property
     def system_alert(self) -> Optional[SystemAlert]:
         return self.payload if self.kind == "system_alert" else None
 
@@ -743,6 +770,27 @@ LABEL_SESSION_KEY = "cordum.session_key"
 # stream consumers but never persisted as a job event (per-token events
 # would swamp the job store's event log).
 STATUS_HINT_STREAM = "stream"
+
+# Forced-decode resume prefix (docs/SERVING.md §Migration, drain, and
+# failover): when the scheduler fails a serving session over to a new
+# worker it stamps the tokens the dead worker already streamed as a
+# comma-joined label; the new worker prefills prompt + prefix (forced
+# decode), re-emits the prefix at offset 0 (consumers dedupe by offset),
+# and continues generating from there — no duplicated or missing tokens.
+LABEL_RESUME_TOKENS = "cordum.resume_tokens"
+
+# JobResult.error_code of a NON-terminal (status=RUNNING) result a worker
+# publishes to hand a job back to the scheduler for failover instead of
+# failing it: a draining worker with no migration target, or a crashed
+# decode loop's live sessions.  The scheduler re-dispatches (bounded by the
+# attempts counter) rather than recording a terminal state.
+ERROR_SESSION_REQUEUE = "SESSION_REQUEUE"
+
+# Heartbeat labels a serving worker advertises so peers can live-migrate KV
+# pages to it: the migration listener's host:port, and its free-page count
+# (the capacity-matrix KV headroom signal drain uses to pick a target).
+LABEL_MIGRATE_ADDR = "cordum.migrate_addr"
+LABEL_KV_PAGES_FREE = "cordum.kv_pages_free"
 
 
 def payload_session_key(payload: Any) -> str:

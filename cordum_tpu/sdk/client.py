@@ -213,9 +213,22 @@ class Client:
                 if pl.get("job_id") != job_id:
                     continue
                 if pkt.get("kind") == "job_progress" and pl.get("status_hint") == "stream":
-                    for t in pl.get("tokens") or []:
-                        n_seen += 1
-                        yield int(t)
+                    # dedupe by token offset: a failed-over session's new
+                    # worker replays the already-streamed prefix at offset
+                    # 0, so indexes below n_seen are duplicates to skip and
+                    # exactly index n_seen extends the stream — the
+                    # assembled sequence is exactly-once across worker
+                    # crashes and migrations (docs/SERVING.md).  A gap
+                    # (index above n_seen: a lost packet) is left for the
+                    # authoritative terminal-result tail below.
+                    toks = pl.get("tokens") or []
+                    off = pl.get("offset")
+                    if not isinstance(off, int) or off < 0:
+                        off = n_seen  # legacy packets: assume contiguous
+                    for i, t in enumerate(toks):
+                        if off + i == n_seen:
+                            n_seen += 1
+                            yield int(t)
                 elif pkt.get("kind") == "job_result":
                     if pl.get("status") != "SUCCEEDED":
                         raise ApiError(
@@ -324,6 +337,15 @@ class Client:
 
     async def workers(self) -> dict:
         return await self._req("GET", "/api/v1/workers")
+
+    async def drain_worker(self, worker_id: str, *, reason: str = "") -> dict:
+        """Gracefully drain a worker: it stops admitting, live-migrates its
+        serving sessions to peers, finishes per-job work, then exits with
+        zero CANCELLED sessions (docs/SERVING.md §Migration)."""
+        return await self._req(
+            "POST", f"/api/v1/workers/{worker_id}/drain",
+            json={"reason": reason} if reason else {},
+        )
 
     async def install_pack(self, manifest: dict) -> dict:
         return await self._req("POST", "/api/v1/packs", json=manifest)

@@ -14,6 +14,9 @@ state.  This package adds the serving path:
     sessions and retires finished ones every step, schedules chunked
     prefill *inside* the mixed step under a token budget, streams tokens,
     frees pages on retirement/cancel
+  * :class:`MigrationServer` / :func:`migrate_session` — live KV-page
+    session migration between workers over the statebus frame layer
+    (graceful drain + crash failover, docs/SERVING.md §Migration)
 
 ``llm.generate`` jobs route here from the worker intake (see
 ``worker/runtime.py``); the scheduler pins a conversation's jobs to the
@@ -21,16 +24,29 @@ worker holding its KV pages via the ``cordum.session_key`` affinity map
 (``controlplane/scheduler/strategy.py``).
 """
 from .backend import LlamaServingBackend, StepEntry
-from .engine import GenRequest, ServingEngine, ServingStats, SessionCancelled
+from .engine import (
+    GenRequest,
+    ServingEngine,
+    ServingStats,
+    SessionCancelled,
+    SessionMigrated,
+    SessionRequeued,
+)
+from .migration import MigrationError, MigrationServer, migrate_session
 from .pager import CacheExhausted, PageAllocator
 
 __all__ = [
     "CacheExhausted",
     "GenRequest",
     "LlamaServingBackend",
+    "MigrationError",
+    "MigrationServer",
     "PageAllocator",
     "ServingEngine",
     "ServingStats",
     "SessionCancelled",
+    "SessionMigrated",
+    "SessionRequeued",
     "StepEntry",
+    "migrate_session",
 ]

@@ -201,6 +201,66 @@ class LlamaServingBackend:
                 for i, e in enumerate(entries)]
 
     # ------------------------------------------------------------------
+    # live KV-page migration (serving/migration.py, docs/PROTOCOL.md §Page
+    # transfer): pages leave and enter the arena at their TRUE lengths —
+    # only the filled slots of each page ride the wire, float32-upcast so
+    # the receiver can cast back into its own arena dtype exactly.
+    def export_kv(
+        self, pages: list[int], start_tok: int, end_tok: int
+    ) -> list[dict]:
+        """Records for the session pages covering positions
+        ``[start_tok, end_tok)``.  ``pages`` is the session's full page
+        list; record ``i`` is the page ORDINAL within it (the receiver maps
+        ordinals onto its own freshly allocated arena blocks).  Blocking
+        (device reads); call from an executor thread."""
+        if end_tok <= start_tok:
+            return []
+        self._ensure()
+        from ..models import llama
+
+        ps = self.page_size
+        first, last = start_tok // ps, -(-end_tok // ps)
+        ords = list(range(first, min(last, len(pages))))
+        used = [min(ps, end_tok - o * ps) for o in ords]
+        # under the device lock: on donating backends a concurrent step
+        # invalidates the arena buffers it was handed, so the gather must
+        # not overlap a step's jit call (page CONTENT below end_tok is
+        # stable either way — steps only write at the current positions)
+        with self._dev_lock:
+            blocks = llama.gather_kv_pages(
+                self._k_pages, self._v_pages, [pages[o] for o in ords], used
+            )
+        return [
+            {"i": o, "used": n, "k": k.tobytes(), "v": v.tobytes(),
+             "shape": list(k.shape)}
+            for o, n, (k, v) in zip(ords, used, blocks)
+        ]
+
+    def import_kv(self, pages: list[int], records: list[dict]) -> None:
+        """Scatter migrated page records into freshly allocated arena
+        blocks (``pages``, the receiving session's page list).  Blocking;
+        call from an executor thread."""
+        if not records:
+            return
+        self._ensure()
+        from ..models import llama
+
+        ids, blocks = [], []
+        for rec in records:
+            o = int(rec["i"])
+            if not 0 <= o < len(pages):
+                raise ValueError(f"page ordinal {o} outside {len(pages)} pages")
+            shape = tuple(rec["shape"])
+            k = np.frombuffer(rec["k"], np.float32).reshape(shape)
+            v = np.frombuffer(rec["v"], np.float32).reshape(shape)
+            ids.append(pages[o])
+            blocks.append((k, v))
+        with self._dev_lock:
+            self._k_pages, self._v_pages = llama.scatter_kv_pages(
+                self._k_pages, self._v_pages, ids, blocks
+            )
+
+    # ------------------------------------------------------------------
     # compat conveniences over step() — tests and benches drive these; the
     # engine always assembles mixed steps itself.  Both ride the SAME
     # ragged program: there is nothing else to compile.

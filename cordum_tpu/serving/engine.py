@@ -55,6 +55,20 @@ class SessionCancelled(Exception):
     decoding); the worker publishes an ordinary CANCELLED result."""
 
 
+class SessionMigrated(Exception):
+    """Session live-migrated to a peer worker (docs/SERVING.md §Migration,
+    drain, and failover): the target owns the token stream and the terminal
+    result now — the local waiter publishes NOTHING."""
+
+
+class SessionRequeued(Exception):
+    """Session handed back to the scheduler for failover (drain with no
+    migration target, crashed decode loop): the worker publishes a
+    non-terminal ``SESSION_REQUEUE`` result and the scheduler re-dispatches
+    with the already-streamed tokens as a forced-decode prefix — bounded by
+    the attempts counter, FAILED only past the cap."""
+
+
 @dataclass
 class GenRequest:
     """A decomposed ``llm.generate`` payload."""
@@ -64,6 +78,12 @@ class GenRequest:
     session_key: str = ""
     eos_token: Optional[int] = None
     stream: bool = True
+    # failover resume (LABEL_RESUME_TOKENS): tokens a previous worker
+    # already generated and streamed for this job.  They prefill as a
+    # forced-decode prefix (prompt + resume ride the chunked prefill path),
+    # count toward max_new_tokens, and replay at offset 0 so stream
+    # consumers deduping by offset see an exactly-once sequence.
+    resume_tokens: list[int] = field(default_factory=list)
 
 
 @dataclass
@@ -76,6 +96,9 @@ class ServingStats:
     decoded_tokens: int = 0  # generated tokens (decode rows + first tokens)
     prefill_tokens: int = 0  # prompt tokens fed through mixed-step chunks
     prefill_chunks: int = 0
+    migrated_out: int = 0  # sessions live-migrated to a peer worker
+    migrated_in: int = 0  # sessions adopted from a peer worker
+    requeued: int = 0  # sessions handed back to the scheduler for failover
     occupancy_sum: int = 0
     max_occupancy: int = 0
     admission_waits: int = 0  # admissions delayed by cache exhaustion
@@ -101,11 +124,26 @@ class _Session:
     last_token: int = 0
     out_tokens: list[int] = field(default_factory=list)
     cancelled: bool = False
+    # frozen = mid-migration: the step loop must not advance this session
+    # (decode pauses only for the final freeze-and-delta chunk)
+    frozen: bool = False
     enqueued_at: float = field(default_factory=time.monotonic)
 
     @property
+    def prefill_seq(self) -> list[int]:
+        """What prefill must feed: the prompt plus the forced-decode resume
+        prefix MINUS its last token (failover replay, docs/SERVING.md).
+        The final resume token stays ``last_token``: the first post-resume
+        step is then an ordinary decode row feeding it at the next
+        position — the exact state a live-migrated session resumes from,
+        so the continuation token is sampled with decode semantics, not a
+        prefill-completion sample."""
+        seq = self.req.prompt + self.req.resume_tokens
+        return seq[:-1] if self.req.resume_tokens else seq
+
+    @property
     def prefilled(self) -> bool:
-        return self.prefill_pos >= len(self.req.prompt)
+        return self.prefill_pos >= len(self.prefill_seq)
 
     @property
     def done(self) -> bool:
@@ -162,6 +200,10 @@ class ServingEngine:
         self._wake = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
         self._closed = False
+        # job ids riding the step currently on the device: a migration
+        # freeze is complete only once the in-flight step (which may still
+        # produce one token for the session) has scattered its results
+        self._in_step: frozenset[str] = frozenset()
 
     # ------------------------------------------------------------------
     def parts(self, payload: Any) -> Optional[GenRequest]:
@@ -238,10 +280,22 @@ class ServingEngine:
             on_tokens=on_tokens if gen.stream else None,
             trace_id=trace_id, parent_span_id=parent_span_id,
         )
+        if gen.resume_tokens:
+            # forced-decode resume: the prefix counts as already-generated
+            # output; prefill feeds prompt + prefix and decoding continues
+            # from the prefix's last token
+            sess.out_tokens = list(gen.resume_tokens)
+            sess.last_token = gen.resume_tokens[-1]
         self._pending.append(sess)
         self._ensure_loop()
         self._wake.set()
         tokens = await sess.future
+        return self.result_doc(gen, tokens)
+
+    @staticmethod
+    def result_doc(gen: GenRequest, tokens: list[int]) -> dict[str, Any]:
+        """The terminal result payload for a finished generation — shared by
+        :meth:`submit` and the migrated-session adoption path."""
         return {
             "tokens": tokens,
             "n_tokens": len(tokens),
@@ -281,17 +335,20 @@ class ServingEngine:
     def _on_loop_done(self, task: asyncio.Task) -> None:
         """Step failures are handled inside the loop; anything that still
         escapes must not strand live sessions on never-resolving futures —
-        fail them loudly (each publishes an ordinary FAILED result) and let
-        the next submit restart the loop."""
+        hand them back to the scheduler for failover (each publishes a
+        non-terminal SESSION_REQUEUE result; the attempts counter bounds the
+        retries, so a deterministic crasher still ends FAILED past the cap)
+        and let the next submit restart the loop."""
         if task.cancelled() or self._closed:
             return
         exc = task.exception()
         if exc is None:
             return
-        logx.warn("decode loop crashed; failing live sessions", err=str(exc))
+        logx.warn("decode loop crashed; requeueing live sessions", err=str(exc))
         for sess in [*self._pending, *self._active.values()]:
-            self.stats.failed += 1
-            self._retire(sess, error=exc)
+            self._retire(sess, error=SessionRequeued(
+                f"decode loop crashed: {exc}"
+            ))
         self._pending.clear()
 
     def _gauge(self) -> None:
@@ -324,6 +381,16 @@ class ServingEngine:
             self.stats.admitted += 1
             if self.metrics is not None:
                 self.metrics.serving_admitted.inc()
+            if sess.out_tokens and sess.on_tokens is not None:
+                # failover resume: replay the already-streamed prefix at
+                # offset 0 — consumers dedupe by offset, so a client that
+                # saw the original stream skips it and one that missed
+                # packets in the crash window backfills
+                asyncio.ensure_future(self._emit(sess, list(sess.out_tokens)))
+            if sess.done:
+                # the crash landed after the final token: nothing left to
+                # decode — finish straight from the resume prefix
+                self._retire(sess)
 
     async def _emit(self, sess: _Session, new_tokens: list[int]) -> None:
         if sess.on_tokens is None:
@@ -344,12 +411,18 @@ class ServingEngine:
                 sess.future.set_result(list(sess.out_tokens))
         else:
             if isinstance(error, SessionCancelled):
+                reason = "cancelled"
                 self.stats.cancelled += 1
+            elif isinstance(error, SessionMigrated):
+                reason = "migrated"
+                self.stats.migrated_out += 1
+            elif isinstance(error, SessionRequeued):
+                reason = "requeued"
+                self.stats.requeued += 1
+            else:
+                reason = "failed"
             if self.metrics is not None:
-                self.metrics.serving_retired.inc(
-                    reason="cancelled" if isinstance(error, SessionCancelled)
-                    else "failed"
-                )
+                self.metrics.serving_retired.inc(reason=reason)
             if not sess.future.done():
                 sess.future.set_exception(error)
 
@@ -364,7 +437,9 @@ class ServingEngine:
         budget = self.step_tokens
         chunks = 0
         for sess in self._active.values():
-            if not sess.prefilled:
+            # frozen = mid-migration freeze-and-delta: the session's pages
+            # are being shipped; its rows sit this step (and the next) out
+            if not sess.prefilled or sess.frozen:
                 continue
             entries.append(StepEntry(
                 tokens=[sess.last_token], start=sess.pos, pages=sess.pages,
@@ -373,16 +448,28 @@ class ServingEngine:
             rows.append((sess, 1, True))
             budget -= 1
         for sess in self._active.values():
-            if sess.prefilled or budget <= 0 or chunks >= self.max_concurrent_prefills:
+            if (
+                sess.prefilled or sess.frozen or budget <= 0
+                or chunks >= self.max_concurrent_prefills
+            ):
                 continue
-            chunk = min(budget, len(sess.req.prompt) - sess.prefill_pos)
-            completes = sess.prefill_pos + chunk >= len(sess.req.prompt)
+            # the prefill sequence is prompt + any forced-decode resume
+            # prefix (minus its last token, which decodes as a normal row);
+            # the completing chunk samples only for resume-free sessions
+            # with output still to generate
+            seq = sess.prefill_seq
+            chunk = min(budget, len(seq) - sess.prefill_pos)
+            completes = sess.prefill_pos + chunk >= len(seq)
+            samples = (
+                completes and not sess.done and not sess.req.resume_tokens
+            )
             entries.append(StepEntry(
-                tokens=sess.req.prompt[sess.prefill_pos:sess.prefill_pos + chunk],
+                tokens=seq[sess.prefill_pos:sess.prefill_pos + chunk],
                 start=sess.prefill_pos, pages=sess.pages,
-                sample=completes, phase="prefill", key=sess.job_id,
+                sample=samples, phase="prefill",
+                key=sess.job_id,
             ))
-            rows.append((sess, chunk, completes))
+            rows.append((sess, chunk, samples))
             budget -= chunk
             chunks += 1
         return entries, rows
@@ -422,12 +509,14 @@ class ServingEngine:
                     parent_span_id=oldest.parent_span_id,
                     attrs={"occupancy": str(len(rows))},
                 )
+            self._in_step = frozenset(s.job_id for s, _, _ in rows)
             try:
                 results = await self.run_blocking(self.backend.step, entries)
             except Exception as e:  # noqa: BLE001 - whole-step failure
                 # a poisoned step fails every rider (pages freed); the next
                 # tick starts clean — mirrors the batcher's isolation intent
                 # without re-running autoregressive state per item
+                self._in_step = frozenset()
                 logx.warn("serving step failed", occupancy=len(rows), err=str(e))
                 if step_span is not None and self.tracer is not None:
                     step_span.attrs["error"] = type(e).__name__
@@ -480,6 +569,9 @@ class ServingEngine:
                 )
             if emits:
                 await asyncio.gather(*emits)
+            # every token of this step is appended AND emitted: a freeze
+            # waiting on wait_quiesced() now sees a fully consistent session
+            self._in_step = frozenset()
             if self.metrics is not None:
                 self.metrics.serving_batch_occupancy.observe(float(len(rows)))
                 self.metrics.serving_inter_token.observe(dt)
@@ -492,6 +584,185 @@ class ServingEngine:
             # yield to the loop so intake/cancel/heartbeat tasks run between
             # steps even under a saturated decode set
             await asyncio.sleep(0)
+
+    # ------------------------------------------------------------------
+    # live migration (serving/migration.py, docs/SERVING.md §Migration,
+    # drain, and failover).  The engine side is deliberately mechanical:
+    # describe → stream stable pages live → freeze → export the delta →
+    # complete (retire as SessionMigrated) or unfreeze on failure.
+    # ------------------------------------------------------------------
+    def session_ids(self) -> list[str]:
+        """Every live session, decoding first (pending last): the order a
+        drain migrates them in — decoding sessions carry KV state worth
+        moving; pending ones are requeued cheaply."""
+        return [*self._active.keys(), *(s.job_id for s in self._pending)]
+
+    def describe_session(self, job_id: str) -> Optional[dict[str, Any]]:
+        """The session's immutable metadata (the migration hello frame);
+        None when it is not actively decoding here."""
+        sess = self._active.get(job_id)
+        if sess is None or sess.cancelled:
+            return None
+        req = sess.req
+        return {
+            "job_id": sess.job_id,
+            "prompt": list(req.prompt),
+            "resume_tokens": list(req.resume_tokens),
+            "max_new_tokens": req.max_new_tokens,
+            "session_key": req.session_key,
+            "eos_token": req.eos_token,
+            "stream": req.stream,
+            "trace_id": sess.trace_id,
+            "page_size": self.allocator.page_size,
+            "n_pages": len(sess.pages),
+        }
+
+    def export_state(self, job_id: str) -> Optional[dict[str, Any]]:
+        """The session's mutable decode state — valid only once frozen and
+        quiesced (the commit frame's ``state``)."""
+        sess = self._active.get(job_id)
+        if sess is None:
+            return None
+        return {
+            "pos": sess.pos,
+            "prefill_pos": sess.prefill_pos,
+            "out_tokens": list(sess.out_tokens),
+            "last_token": sess.last_token,
+        }
+
+    async def export_pages(
+        self, job_id: str, start_tok: int, end_tok: int
+    ) -> list[dict]:
+        """Page records covering positions ``[start_tok, end_tok)`` at
+        their true lengths (backends without an arena export nothing — the
+        receiver rebuilds from the metadata via ``restore_session``)."""
+        sess = self._active.get(job_id)
+        fn = getattr(self.backend, "export_kv", None)
+        if sess is None or fn is None:
+            return []
+        return await self.run_blocking(fn, sess.pages, start_tok, end_tok)
+
+    def freeze_session(self, job_id: str) -> bool:
+        """Pause the session's decode (it sits out subsequent steps);
+        False when it is not actively decoding here."""
+        sess = self._active.get(job_id)
+        if sess is None or sess.cancelled:
+            return False
+        sess.frozen = True
+        return True
+
+    def unfreeze_session(self, job_id: str) -> None:
+        """Resume a frozen session (migration failed: decode continues
+        locally as if nothing happened)."""
+        sess = self._active.get(job_id)
+        if sess is not None:
+            sess.frozen = False
+            self._wake.set()
+
+    async def wait_quiesced(self, job_id: str) -> None:
+        """Block until the in-flight step (which may still produce one
+        token for a just-frozen session) has scattered its results."""
+        while job_id in self._in_step:
+            await asyncio.sleep(0.002)
+
+    def complete_migration(self, job_id: str) -> bool:
+        """The target committed: retire locally as migrated — the waiter
+        publishes nothing (the target owns stream + terminal result)."""
+        sess = self._active.get(job_id)
+        if sess is None:
+            return False
+        self._retire(sess, error=SessionMigrated(job_id))
+        return True
+
+    def requeue(self, job_id: str, reason: str = "") -> bool:
+        """Hand a session (pending or active) back to the scheduler for
+        failover — the drain fallback when no peer can take its pages."""
+        for i, sess in enumerate(self._pending):
+            if sess.job_id == job_id:
+                del self._pending[i]
+                self._retire(sess, error=SessionRequeued(reason or job_id))
+                return True
+        sess = self._active.get(job_id)
+        if sess is None:
+            return False
+        self._retire(sess, error=SessionRequeued(reason or job_id))
+        return True
+
+    async def install_session(
+        self,
+        req: GenRequest,
+        *,
+        job_id: str,
+        state: dict[str, Any],
+        records: list[dict],
+        trace_id: str = "",
+        parent_span_id: str = "",
+        on_tokens: Optional[TokenSink] = None,
+    ) -> asyncio.Future:
+        """Adopt a migrated-in session: allocate fresh arena blocks,
+        scatter the shipped page records into them, and resume decoding
+        exactly where the source froze.  Raises (``CacheExhausted`` /
+        ``ValueError``) when this worker cannot take it — the source then
+        falls back to a scheduler requeue.  Returns the session's result
+        future (token list)."""
+        if self._closed:
+            raise RuntimeError("serving engine is stopped")
+        if job_id in self._active or any(
+            s.job_id == job_id for s in self._pending
+        ):
+            raise ValueError(f"session {job_id} already live on this worker")
+        total = len(req.prompt) + req.max_new_tokens
+        if self.max_context and total > self.max_context:
+            raise ValueError(
+                f"migrated session spans {total} tokens; backend max_context "
+                f"is {self.max_context}"
+            )
+        if len(self._active) >= self.max_sessions:
+            raise CacheExhausted(
+                f"{len(self._active)} active sessions; max {self.max_sessions}"
+            )
+        pages = self.allocator.alloc(job_id, self.allocator.pages_for(total))
+        try:
+            imp = getattr(self.backend, "import_kv", None)
+            if imp is not None and records:
+                await self.run_blocking(imp, pages, records)
+        except BaseException:
+            self.allocator.free(job_id)
+            raise
+        sess = _Session(
+            job_id=job_id, req=req,
+            future=asyncio.get_running_loop().create_future(),
+            on_tokens=on_tokens if req.stream else None,
+            trace_id=trace_id, parent_span_id=parent_span_id,
+        )
+        sess.pages = pages
+        sess.pos = int(state.get("pos", 0) or 0)
+        sess.prefill_pos = int(state.get("prefill_pos", 0) or 0)
+        sess.out_tokens = [int(t) for t in state.get("out_tokens") or []]
+        sess.last_token = int(state.get("last_token", 0) or 0)
+        # arena-less backends (test fakes) rebuild their per-session decode
+        # state from the metadata instead of imported pages
+        restore = getattr(self.backend, "restore_session", None)
+        if restore is not None:
+            restore(job_id, sess.prefill_seq, sess.prefill_pos)
+        self._active[job_id] = sess
+        self.stats.admitted += 1
+        self.stats.migrated_in += 1
+        if self.metrics is not None:
+            self.metrics.serving_admitted.inc()
+            self.metrics.serving_migrations.inc(role="in", outcome="ok")
+        if sess.out_tokens and sess.on_tokens is not None:
+            # replay the carried tokens at offset 0: dedupe-by-offset makes
+            # it a no-op for clients that saw them and a backfill for
+            # clients that lost packets in the handover window
+            asyncio.ensure_future(self._emit(sess, list(sess.out_tokens)))
+        if sess.done:
+            self._retire(sess)
+        else:
+            self._ensure_loop()
+            self._wake.set()
+        self._gauge()
+        return sess.future
 
     # ------------------------------------------------------------------
     async def stop(self) -> None:

@@ -39,17 +39,28 @@ from ..obs.tracer import Tracer
 from ..protocol import subjects as subj
 from ..protocol.types import (
     BusPacket,
+    ERROR_SESSION_REQUEUE,
     Heartbeat,
     JobCancel,
     JobProgress,
     JobRequest,
     JobResult,
     JobState,
+    LABEL_KV_PAGES_FREE,
+    LABEL_MIGRATE_ADDR,
     LABEL_PARTITION,
+    LABEL_RESUME_TOKENS,
     STATUS_HINT_STREAM,
     Span,
 )
-from ..serving.engine import GenRequest, ServingEngine, SessionCancelled
+from ..serving.engine import (
+    GenRequest,
+    ServingEngine,
+    SessionCancelled,
+    SessionMigrated,
+    SessionRequeued,
+)
+from ..serving.migration import MigrationError, MigrationServer, migrate_session
 from ..utils.ids import new_id
 
 HEARTBEAT_INTERVAL_S = 10.0
@@ -144,6 +155,9 @@ class Worker:
         self._completed: dict[str, JobResult] = {}
         self._completed_cap = 512
         self._subs: list = []
+        # pool-topic subscriptions kept separate: drain drops ONLY these
+        # (the direct/cancel subjects stay live for in-flight work)
+        self._topic_subs: list = []
         self._hb_task: Optional[asyncio.Task] = None
         self._executor = ThreadPoolExecutor(max_workers=max_parallel_jobs, thread_name_prefix=f"{worker_id}-jax")
         self.tracer = Tracer("worker", bus)
@@ -155,6 +169,16 @@ class Worker:
         # budget + max_sessions) bounds concurrency, and a session parked in
         # the decode loop must not starve the per-job lanes
         self._serving: Optional[ServingEngine] = None
+        # serving session failover (docs/SERVING.md §Migration, drain, and
+        # failover): the migration listener adopting peer sessions, the
+        # peer map (fed by fan-out heartbeats) drain picks targets from,
+        # and the drain state machine
+        self._migration: Optional[MigrationServer] = None
+        self._peers: dict[str, dict] = {}
+        self._session_partition: dict[str, str] = {}
+        self._draining = False
+        self._drained = asyncio.Event()
+        self._drain_task: Optional[asyncio.Task] = None
         self._telemetry = _device_telemetry()
         # capacity observatory (ISSUE 10): online per-(op, bucket) device
         # profiles published in the telemetry beacon's `capacity` block
@@ -217,8 +241,20 @@ class Worker:
             await self.bus.subscribe(subj.direct_subject(self.worker_id), self._on_job, queue=self.worker_id)
         )
         for topic in self.topics:
-            self._subs.append(await self.bus.subscribe(topic, self._on_job, queue=self.pool))
+            self._topic_subs.append(await self.bus.subscribe(topic, self._on_job, queue=self.pool))
         self._subs.append(await self.bus.subscribe(subj.CANCEL, self._on_cancel))
+        self._subs.append(await self.bus.subscribe(subj.DRAIN, self._on_drain))
+        if self._serving is not None:
+            # live-migration listener + the peer map drain targets come
+            # from (fan-out heartbeats carry each peer's listener address
+            # and KV-page headroom)
+            self._migration = MigrationServer(
+                self._adopt_session, metrics=self._serving.metrics
+            )
+            await self._migration.start()
+            self._subs.append(
+                await self.bus.subscribe(subj.HEARTBEAT, self._on_peer_heartbeat)
+            )
         self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
         await self.send_heartbeat()
 
@@ -231,9 +267,13 @@ class Worker:
                 pass
             except Exception as e:  # noqa: BLE001 - logged, never swallowed
                 logx.warn("heartbeat loop crashed during shutdown", err=str(e))
-        for s in self._subs:
+        for s in [*self._subs, *self._topic_subs]:
             s.unsubscribe()
         self._subs = []
+        self._topic_subs = []
+        if self._migration is not None:
+            await self._migration.stop()
+            self._migration = None
         if self._batcher is not None:
             await self._batcher.stop()  # drain queued batches before the pool dies
         if self._serving is not None:
@@ -258,9 +298,226 @@ class Worker:
             # SessionCancelled → ordinary CANCELLED result
             self._serving.cancel(c.job_id)
 
+    # ------------------------------------------------------------------
+    # graceful drain + session migration (docs/SERVING.md §Migration,
+    # drain, and failover)
+    # ------------------------------------------------------------------
+    async def _on_drain(self, subject: str, pkt: BusPacket) -> None:
+        wd = pkt.worker_drain
+        if wd is None or (wd.worker_id and wd.worker_id != self.worker_id):
+            return
+        logx.info("drain requested", worker_id=self.worker_id,
+                  requested_by=wd.requested_by, reason=wd.reason)
+        if self._drain_task is None:
+            self._drain_task = asyncio.ensure_future(self.drain())
+
+    async def _on_peer_heartbeat(self, subject: str, pkt: BusPacket) -> None:
+        hb = pkt.heartbeat
+        if hb is None or not hb.worker_id or hb.worker_id == self.worker_id:
+            return
+        addr = (hb.labels or {}).get(LABEL_MIGRATE_ADDR, "")
+        if not addr:
+            return
+        try:
+            pages_free = int((hb.labels or {}).get(LABEL_KV_PAGES_FREE, "0") or 0)
+        except ValueError:
+            pages_free = 0
+        if len(self._peers) > 1024:
+            self._peers.clear()  # unbounded-fleet guard
+        self._peers[hb.worker_id] = {
+            "addr": addr,
+            "pages_free": pages_free,
+            "draining": bool(hb.draining),
+            "seen": time.monotonic(),
+        }
+
+    def _pick_migration_peer(self) -> str:
+        """The live, non-draining peer with the most free KV pages (the
+        capacity-matrix headroom signal carried on heartbeats); "" when no
+        peer can take sessions — drain then falls back to requeueing."""
+        window = max(30.0, 3 * self.heartbeat_interval_s)
+        now = time.monotonic()
+        best, best_free = "", -1
+        for wid, p in self._peers.items():
+            if p["draining"] or now - p["seen"] > window:
+                continue
+            if p["pages_free"] > best_free:
+                best, best_free = p["addr"], p["pages_free"]
+        return best
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    async def drain(self, timeout_s: float = 60.0) -> None:
+        """Graceful drain: stop admitting, live-migrate every serving
+        session to the peer with the most KV headroom (scheduler requeue as
+        the fallback — zero CANCELLED sessions either way), let per-job
+        work finish, and beacon ``draining`` so the scheduler deregisters
+        this worker and evicts its affinity entries.  Idempotent; the
+        caller (cmd/worker) exits once it returns."""
+        if self._draining:
+            await self._drained.wait()
+            return
+        self._draining = True
+        logx.info("worker draining", worker_id=self.worker_id,
+                  sessions=self._serving.session_count if self._serving else 0,
+                  active_jobs=len(self._active))
+        try:
+            # the draining heartbeat deregisters us and evicts our
+            # session/batch affinity BEFORE sessions start moving, so no
+            # new turn races its session's migration
+            await self.send_heartbeat()
+        except Exception:  # noqa: BLE001 - beacon loss must not stop the drain
+            logx.warn("draining heartbeat failed", worker_id=self.worker_id)
+        for s in self._topic_subs:
+            s.unsubscribe()
+        self._topic_subs = []
+        if self._serving is not None:
+            for job_id in list(self._serving.session_ids()):
+                moved = False
+                peer = self._pick_migration_peer()
+                if peer and self._serving.describe_session(job_id) is not None:
+                    host, _, port = peer.rpartition(":")
+                    try:
+                        moved = await migrate_session(
+                            self._serving, job_id, host, int(port),
+                            meta_extra={
+                                "partition": self._session_partition.get(job_id, ""),
+                            },
+                            metrics=self._serving.metrics,
+                        )
+                    except Exception as e:  # noqa: BLE001 - fall back to requeue
+                        logx.warn("migration attempt crashed", job_id=job_id,
+                                  err=str(e))
+                if not moved:
+                    # pending sessions (no KV state) and unmigratable ones
+                    # go back to the scheduler — re-dispatched, not killed
+                    self._serving.requeue(job_id, "worker draining")
+        deadline = time.monotonic() + timeout_s
+        while self._active and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if self._active:
+            logx.warn("drain timeout with jobs still active",
+                      worker_id=self.worker_id, jobs=len(self._active))
+        try:
+            await self.send_heartbeat()  # final draining beacon
+        except Exception as e:  # noqa: BLE001 - beacon loss must not stop the drain
+            logx.warn("final draining heartbeat failed",
+                      worker_id=self.worker_id, err=str(e))
+        logx.info("worker drained", worker_id=self.worker_id)
+        self._drained.set()
+
+    async def wait_drained(self) -> None:
+        await self._drained.wait()
+
+    async def _adopt_session(self, meta: dict, state: dict, records: list) -> None:
+        """Migration-listener install callback: adopt a peer's session —
+        scatter its shipped pages into our arena and resume decoding.
+        Raises to refuse (the sender falls back to a scheduler requeue)."""
+        serving = self._serving
+        if serving is None or self._draining:
+            raise MigrationError("worker not accepting sessions")
+        job_id = str(meta.get("job_id", ""))
+        if not job_id:
+            raise MigrationError("migration meta missing job_id")
+        if job_id in self._completed:
+            raise MigrationError(f"job {job_id} already completed here")
+        eos = meta.get("eos_token")
+        gen = GenRequest(
+            prompt=[int(t) for t in meta.get("prompt") or []],
+            max_new_tokens=int(meta.get("max_new_tokens", 16) or 16),
+            session_key=str(meta.get("session_key", "") or ""),
+            eos_token=int(eos) if isinstance(eos, int) else None,
+            stream=bool(meta.get("stream", True)),
+            resume_tokens=[int(t) for t in meta.get("resume_tokens") or []],
+        )
+        trace_id = str(meta.get("trace_id", "") or "")
+        fut = await serving.install_session(
+            gen, job_id=job_id, state=state, records=records,
+            trace_id=trace_id, on_tokens=self._token_sink(job_id, gen),
+        )
+        self._session_partition[job_id] = str(meta.get("partition", "") or "")
+        asyncio.ensure_future(self._finish_adopted(job_id, gen, trace_id, fut))
+
+    async def _finish_adopted(
+        self, job_id: str, gen: GenRequest, trace_id: str, fut: asyncio.Future
+    ) -> None:
+        """Await an adopted session and publish its terminal result — the
+        half of ``_run_job`` a migrated-in job still needs (the source
+        worker's waiter publishes nothing once migration commits)."""
+        t0 = time.monotonic()
+        partition = self._session_partition.pop(job_id, "")
+        status = JobState.SUCCEEDED.value
+        error_code = error_message = result_ptr = ""
+        try:
+            tokens = await fut
+            out = ServingEngine.result_doc(gen, tokens)
+            result_ptr = await self.store.put_result(job_id, out)
+        except SessionMigrated:
+            return  # chained onward migration: the next owner publishes
+        except SessionRequeued as e:
+            await self._publish_requeue(job_id, str(e) or "requeued",
+                                        trace_id=trace_id, partition=partition)
+            return
+        except SessionCancelled:
+            status = JobState.CANCELLED.value
+            error_code, error_message = "CANCELLED", "cancelled"
+        except Exception as e:  # noqa: BLE001 - adopted session failed
+            status = JobState.FAILED.value
+            error_code = type(e).__name__
+            error_message = str(e) or error_code
+        res = JobResult(
+            job_id=job_id,
+            status=status,
+            result_ptr=result_ptr,
+            worker_id=self.worker_id,
+            execution_ms=int((time.monotonic() - t0) * 1000),
+            error_code=error_code,
+            error_message=error_message,
+        )
+        self._completed[job_id] = res
+        await self.bus.publish(
+            subj.stamped_result_subject(partition),
+            BusPacket.wrap(res, trace_id=trace_id, sender_id=self.worker_id),
+        )
+
+    async def _publish_requeue(
+        self, job_id: str, reason: str, *, trace_id: str = "", partition: str = ""
+    ) -> None:
+        """Hand a job back to the scheduler: a NON-terminal RUNNING result
+        with ``error_code=SESSION_REQUEUE`` asks for failover re-dispatch
+        (bounded by the attempts counter) instead of recording a terminal
+        state — used by drain-without-target and the crashed decode loop."""
+        res = JobResult(
+            job_id=job_id,
+            status=JobState.RUNNING.value,
+            worker_id=self.worker_id,
+            error_code=ERROR_SESSION_REQUEUE,
+            error_message=reason,
+            labels={"cordum.bus_msg_id":
+                    f"requeue-{job_id}-{time.monotonic_ns()}"},
+        )
+        await self.bus.publish(
+            subj.stamped_result_subject(partition),
+            BusPacket.wrap(res, trace_id=trace_id, sender_id=self.worker_id),
+        )
+
     async def _on_job(self, subject: str, pkt: BusPacket) -> None:
         req = pkt.job_request
         if req is None or not req.job_id:
+            return
+        if (
+            self._draining
+            and req.job_id not in self._active
+            and req.job_id not in self._completed
+        ):
+            # new work routed here mid-drain (affinity raced the draining
+            # beacon): hand it straight back for failover re-dispatch
+            await self._publish_requeue(
+                req.job_id, "worker draining", trace_id=pkt.trace_id,
+                partition=(req.labels or {}).get(LABEL_PARTITION, ""),
+            )
             return
         payload: Any = _UNFETCHED
         batch_parts: Optional[BatchParts] = None
@@ -278,6 +535,19 @@ class Worker:
                 batch_parts = self._batcher.parts(payload)
             if batch_parts is None and self._serving is not None:
                 gen_req = self._serving.parts(payload)
+                if gen_req is not None:
+                    rt = (req.labels or {}).get(LABEL_RESUME_TOKENS, "")
+                    if rt:
+                        # failover re-dispatch: the scheduler stamped the
+                        # tokens the dead worker already streamed — they
+                        # prefill as a forced-decode prefix and replay at
+                        # offset 0 (docs/SERVING.md §Migration)
+                        try:
+                            gen_req.resume_tokens = [
+                                int(t) for t in rt.split(",") if t
+                            ][: gen_req.max_new_tokens]
+                        except ValueError:
+                            gen_req.resume_tokens = []
         if batch_parts is not None or gen_req is not None:
             # batchable/serving: no semaphore slot — a queued job must not
             # starve the per-job lanes while it waits for batch-mates (or
@@ -334,6 +604,14 @@ class Worker:
         status = JobState.SUCCEEDED.value
         error_code = error_message = ""
         result_ptr = ""
+        migrated = False
+        requeue_reason = ""
+        if gen_req is not None:
+            # remembered for drain-time migration (the commit frame carries
+            # the partition so the adopting worker's result routes home)
+            self._session_partition[req.job_id] = (
+                (req.labels or {}).get(LABEL_PARTITION, "")
+            )
         try:
             if gen_req is not None and self._serving is not None:
                 # serving path: park as a decode session; the continuous-
@@ -383,6 +661,10 @@ class Worker:
         except (JobCancelled, BatchCancelled, SessionCancelled):
             status = JobState.CANCELLED.value
             error_code, error_message = "CANCELLED", "cancelled"
+        except SessionMigrated:
+            migrated = True  # the target worker owns stream + result now
+        except SessionRequeued as e:
+            requeue_reason = str(e) or "requeued"
         except asyncio.CancelledError:
             status = JobState.CANCELLED.value
             error_code, error_message = "CANCELLED", "worker shutdown"
@@ -393,6 +675,20 @@ class Worker:
         finally:
             self._active.pop(req.job_id, None)
             self._mark_idle()
+        self._session_partition.pop(req.job_id, None)
+        if migrated or requeue_reason:
+            # neither outcome is terminal here: a migrated session's target
+            # publishes everything; a requeued one goes back to the
+            # scheduler as a non-terminal SESSION_REQUEUE result — no
+            # completed-cache entry, so a later redelivery can re-run it
+            if not migrated:
+                await self._publish_requeue(
+                    req.job_id, requeue_reason, trace_id=trace_id,
+                    partition=(req.labels or {}).get(LABEL_PARTITION, ""),
+                )
+            exec_span.attrs["status"] = "MIGRATED" if migrated else "REQUEUED"
+            await self.tracer.finish(exec_span)
+            return
         exec_span.attrs["status"] = status
         if error_code:
             exec_span.attrs["error_code"] = error_code
@@ -502,6 +798,11 @@ class Worker:
                         status_hint=STATUS_HINT_STREAM,
                         worker_id=self.worker_id,
                         tokens=list(new_tokens),
+                        # the packet's position in the session's FULL token
+                        # sequence: failover replays the streamed prefix at
+                        # offset 0, and consumers dedupe by offset so the
+                        # assembled stream is exactly-once
+                        offset=max(0, n_generated - len(new_tokens)),
                     ),
                     sender_id=self.worker_id,
                 ),
@@ -536,6 +837,8 @@ class Worker:
         }
         if self._serving is not None:
             out["serving_sessions"] = self._serving.active_sessions()
+        if self._draining:
+            out["draining"] = True
         return out
 
     def _duty_cycle_peek(self) -> float:
@@ -573,6 +876,12 @@ class Worker:
     def build_heartbeat(self) -> Heartbeat:
         tele = self._telemetry
         hbm_used, hbm_total = tele["hbm"]()
+        labels = dict(self.labels)
+        if self._migration is not None and self._serving is not None:
+            # peers live-migrate serving sessions here; the free-page count
+            # is the KV-headroom signal drain target selection ranks by
+            labels[LABEL_MIGRATE_ADDR] = self._migration.addr
+            labels[LABEL_KV_PAGES_FREE] = str(self._serving.allocator.free_pages)
         return Heartbeat(
             worker_id=self.worker_id,
             region=self.region,
@@ -581,7 +890,8 @@ class Worker:
             max_parallel_jobs=self.max_parallel_jobs,
             capabilities=list(self.capabilities),
             pool=self.pool,
-            labels=dict(self.labels),
+            labels=labels,
+            draining=self._draining,
             tpu_duty_cycle=self._duty_cycle(),
             hbm_used_gb=hbm_used,
             hbm_total_gb=hbm_total,

@@ -19,8 +19,11 @@ from pathlib import Path
 
 import pytest
 
-from cordum_tpu.controlplane.scheduler.reconciler import PendingReplayer
-from cordum_tpu.infra.chaos import ChaosProxy, ServerProc, free_port
+from cordum_tpu.controlplane.scheduler.reconciler import (
+    PendingReplayer,
+    WorkerFailover,
+)
+from cordum_tpu.infra.chaos import ChaosProxy, ServerProc, WorkerProc, free_port
 from cordum_tpu.infra.config import Timeouts
 from cordum_tpu.infra.jobstore import JobStore
 from cordum_tpu.infra.replication import probe_role
@@ -97,6 +100,68 @@ async def test_proxy_sever_client_reconnects():
         assert await kv.get("pre") == b"1"
         await wait_for(lambda: conn.reconnect_count >= 1, msg="reconnect count")
         assert proxy.connections_total >= 2
+    finally:
+        await conn.close()
+        await proxy.stop()
+        await srv.stop()
+
+
+async def test_proxy_per_direction_blackhole_is_asymmetric():
+    """blackhole("s2c") models the asymmetric partition: requests still
+    REACH the server (state changes) while replies vanish (the client's
+    call stays parked) — restore releases the parked reply."""
+    srv = StateBusServer(port=0)
+    await srv.start()
+    proxy = ChaosProxy("127.0.0.1", srv.port)
+    await proxy.start()
+    kv, _, conn = await connect(proxy.url)
+    try:
+        await kv.set("pre", b"0")
+        proxy.blackhole("s2c")
+        task = asyncio.ensure_future(kv.set("one-way", b"1"))
+        # the request crossed: the server applied the write...
+        await wait_for(lambda: srv.kv.get("one-way"), msg="server got the write")
+        await asyncio.sleep(0.1)
+        assert not task.done(), "reply crossed a blackholed s2c direction"
+        proxy.restore()
+        await asyncio.wait_for(task, timeout=10)  # parked reply released
+        # the opposite asymmetry: c2s blackholed = requests vanish
+        proxy.blackhole("c2s")
+        t2 = asyncio.ensure_future(kv.set("other-way", b"2"))
+        await asyncio.sleep(0.2)
+        assert await srv.kv.get("other-way") is None, "write crossed c2s hole"
+        assert not t2.done()
+        proxy.restore()
+        await asyncio.wait_for(t2, timeout=10)
+    finally:
+        await conn.close()
+        await proxy.stop()
+        await srv.stop()
+
+
+async def test_proxy_per_direction_delay_composes():
+    """Per-direction delays add up: delaying only c2s costs one delay per
+    round trip, delaying both costs two."""
+    srv = StateBusServer(port=0)
+    await srv.start()
+    proxy = ChaosProxy("127.0.0.1", srv.port)
+    await proxy.start()
+    kv, _, conn = await connect(proxy.url)
+    try:
+        await kv.set("k", b"1")
+        proxy.set_delay(0.15, "c2s")
+        t0 = time.monotonic()
+        await kv.get("k")
+        one_way = time.monotonic() - t0
+        assert 0.15 <= one_way < 0.45, one_way
+        proxy.set_delay(0.15, "s2c")  # now both directions pay
+        t0 = time.monotonic()
+        await kv.get("k")
+        assert time.monotonic() - t0 >= 0.3
+        proxy.restore()
+        t0 = time.monotonic()
+        await kv.get("k")
+        assert time.monotonic() - t0 < 0.15
     finally:
         await conn.close()
         await proxy.stop()
@@ -255,6 +320,166 @@ async def test_replayer_nudges_lost_result_to_completion():
 
 async def _get_state_eq(js: JobStore, jid: str, want: str) -> bool:
     return await js.get_state(jid) == want
+
+
+# ---------------------------------------------------------------------------
+# serving chaos: SIGKILL a serving worker mid-decode, every session resumes
+# (ISSUE 12 acceptance — docs/SERVING.md §Migration, drain, and failover)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # two jax worker subprocesses: its own dedicated CI step
+async def test_sigkill_serving_worker_mid_decode_sessions_resume(tmp_path):
+    """SIGKILL a real ``cmd.worker`` subprocess mid-decode with 3 active
+    llm.generate sessions: the scheduler's WorkerFailover detects the
+    silence, re-dispatches each session to the surviving worker with the
+    already-streamed tokens as a forced-decode prefix, and every client's
+    offset-assembled token stream is EXACTLY the fp32 sequential-oracle
+    output — no duplicated, missing, or divergent tokens."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+    from cordum_tpu.controlplane.scheduler.engine import Engine
+    from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.memstore import MemoryStore
+    from cordum_tpu.infra.registry import WorkerRegistry
+    from cordum_tpu.infra.statebus import connect
+    from cordum_tpu.models import llama
+    from cordum_tpu.protocol.types import LABEL_SESSION_KEY, STATUS_HINT_STREAM
+
+    from .test_serving import ref_greedy
+
+    port = free_port()
+    sb = ServerProc(port, env={"STATEBUS_AOF": str(tmp_path / "s.aof")},
+                    cwd=REPO_ROOT)
+    await sb.start()
+    url = f"statebus://127.0.0.1:{port}"
+    kv, bus, conn = await connect(url)
+    js, ms = JobStore(kv), MemoryStore(kv)
+    kernel = SafetyKernel(policy_doc={
+        "tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}})
+    reg = WorkerRegistry(ttl_s=3.0)
+    pc = parse_pool_config({"topics": {"job.tpu.generate": "tpu"},
+                            "pools": {"tpu": {"requires": []}}})
+    eng = Engine(bus=bus, job_store=js, safety=SafetyClient(kernel.check),
+                 strategy=LeastLoadedStrategy(reg, pc), registry=reg)
+    await eng.start()
+    fo = WorkerFailover(eng, js, reg, Timeouts(scan_interval_s=0.5))
+    await fo.start()
+    # assemble each job's client-visible stream by offset, asserting any
+    # replayed prefix agrees token-for-token with what already streamed
+    streams: dict[str, list[int]] = {}
+
+    async def tap(subject, pkt):
+        pr = pkt.job_progress
+        if pr is None or pr.status_hint != STATUS_HINT_STREAM:
+            return
+        buf = streams.setdefault(pr.job_id, [])
+        off = pr.offset if pr.offset >= 0 else len(buf)
+        for i, t in enumerate(pr.tokens):
+            idx = off + i
+            if idx == len(buf):
+                buf.append(int(t))
+            elif idx < len(buf):
+                assert buf[idx] == int(t), (pr.job_id, idx, buf[idx], t)
+
+    await bus.subscribe(subj.PROGRESS, tap)
+
+    wenv = {
+        "CORDUM_STATEBUS_URL": url,
+        "WORKER_POOL": "tpu",
+        "WORKER_TOPICS": "job.tpu.>",
+        "WORKER_CAPABILITIES": "tpu",
+        "WORKER_HEARTBEAT_INTERVAL": "0.5",
+        # fp32 tiny model: resumed streams compare EXACTLY against the
+        # fp32 oracle computed in this process (same seed, same config)
+        "WORKER_LLAMA_DTYPE": "float32",
+        "WORKER_SERVING_PAGE_SIZE": "8",
+        "WORKER_SERVING_CACHE_PAGES": "128",
+        "WORKER_SERVING_MAX_SESSIONS": "8",
+        "WORKER_SERVING_MAX_NEW_TOKENS": "256",
+        "WORKER_BATCHING": "0",
+    }
+    w1 = WorkerProc("chaos-w1", env=wenv, cwd=REPO_ROOT,
+                    log_path=str(tmp_path / "w1.log"))
+    w2 = WorkerProc("chaos-w2", env=wenv, cwd=REPO_ROOT,
+                    log_path=str(tmp_path / "w2.log"))
+    w1.start()
+    w2.start()
+    jobs: dict[str, list[int]] = {}
+    try:
+        await wait_for(lambda: len(reg.snapshot()) >= 2, 120.0,
+                       "both workers heartbeating")
+        n_new = 96
+        for i, plen in enumerate((3, 9, 14)):
+            jid = f"chaos-gen-{i}"
+            prompt = [(7 * i + j + 1) % 256 for j in range(plen)]
+            jobs[jid] = prompt
+            ptr = await ms.put_context(jid, {
+                "op": "llm.generate", "tokens": prompt,
+                "max_new_tokens": n_new, "session_id": f"conv-chaos-{i}",
+            })
+            await js.set_state(jid, JobState.PENDING, fields={
+                "topic": "job.tpu.generate", "tenant_id": "default",
+            }, event="submit")
+            await js.put_request(JobRequest(
+                job_id=jid, topic="job.tpu.generate", context_ptr=ptr,
+                tenant_id="default",
+                labels={"preferred_worker_id": "chaos-w1",
+                        LABEL_SESSION_KEY: f"conv-chaos-{i}"}))
+            await bus.publish(subj.SUBMIT, BusPacket.wrap(JobRequest(
+                job_id=jid, topic="job.tpu.generate", context_ptr=ptr,
+                tenant_id="default",
+                labels={"preferred_worker_id": "chaos-w1",
+                        LABEL_SESSION_KEY: f"conv-chaos-{i}"}), sender_id="t"))
+
+        # mid-decode: every session has streamed some tokens but none is
+        # close to done — then the worker dies with no warning
+        await wait_for(
+            lambda: all(4 <= len(streams.get(j, [])) for j in jobs)
+            and all(len(streams.get(j, [])) < n_new - 20 for j in jobs),
+            180.0, "all 3 sessions streaming mid-decode")
+        w1.kill()
+        assert not w1.alive
+
+        async def all_succeeded():
+            for jid in jobs:
+                if await js.get_state(jid) != "SUCCEEDED":
+                    return False
+            return True
+
+        try:
+            await wait_for(all_succeeded, 180.0, "sessions resumed on w2")
+        except AssertionError:
+            states = {j: await js.get_state(j) for j in jobs}
+            raise AssertionError(f"sessions stuck after SIGKILL: {states}")
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(), dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        for jid, prompt in jobs.items():
+            oracle = ref_greedy(cfg, params, prompt, n_new)
+            res = await ms.get_result(jid)
+            assert res["tokens"] == oracle, (
+                f"{jid}: resumed output diverges from the oracle")
+            assert streams[jid] == oracle, (
+                f"{jid}: assembled client stream has dup/missing tokens")
+            events = [e["event"] for e in await js.events(jid)]
+            assert "failover" in events, (jid, events)
+            assert "cancelled" not in events
+        assert eng.metrics.session_failovers.value(reason="worker_dead") >= 3
+    finally:
+        w1.kill()
+        w2.kill()
+        await fo.stop()
+        await eng.stop()
+        await conn.close()
+        sb.kill()
 
 
 # ---------------------------------------------------------------------------

@@ -293,7 +293,7 @@ def test_floor_checker_passes_healthy_doc():
            "sharded_jobs_per_sec": 300.0, "sharded_single_jobs_per_sec": 320.0,
            "serving_speedup": 4.5, "serving_affinity_hit_rate": 1.0,
            "decode_tokens_per_sec": 2900.0, "serving_compile_count": 1,
-           "inter_token_p99_ms": 4.0,
+           "inter_token_p99_ms": 4.0, "migration_pause_p50_ms": 10.0,
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4}
@@ -312,7 +312,7 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "sharded_jobs_per_sec": 300.0, "sharded_single_jobs_per_sec": 320.0,
            "serving_speedup": 4.5, "serving_affinity_hit_rate": 1.0,
            "decode_tokens_per_sec": 2900.0, "serving_compile_count": 1,
-           "inter_token_p99_ms": 4.0,
+           "inter_token_p99_ms": 4.0, "migration_pause_p50_ms": 10.0,
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4}

@@ -28,6 +28,12 @@ TPU worker as separate OS processes, then over plain HTTP:
      exactly 1 compiled program, and the capacity matrix's llm.generate
      row carries the warmup compile in its compile split so the
      steady-state tokens/s excludes it
+ 11. serving drain/failover: a second worker joins, live sessions are
+     submitted to the first, and POST /workers/smoke-w1/drain drains it —
+     every session completes SUCCEEDED with its full token count (zero
+     CANCELLED/FAILED), at least one finishes on the peer (live migration
+     or requeue failover), the drained worker beacons draining and exits,
+     and the fleet keeps serving afterwards
 
 Exit 0 = PASS.  Usage: python tools/platform_smoke.py [--keep]
 """
@@ -538,6 +544,90 @@ def main() -> int:
                 f"1 compiled program, capacity row steady tokens/s="
                 f"{srv_row['tokens_per_s']} (compile_n={srv_row['compile_n']} "
                 f"of n={srv_row['n']} excluded)")
+
+            # 11. serving drain/failover: a second worker joins; live
+            # sessions pinned to smoke-w1 are drained off it mid-decode —
+            # live KV-page migration to the peer, with scheduler requeue
+            # (failover) as the fallback for a dispatch that raced the
+            # draining beacon.  Zero CANCELLED/FAILED sessions either way.
+            if not external:
+                w2_env = dict(os.environ)
+                w2_env.update({
+                    "CORDUM_STATEBUS_URL": (
+                        f"statebus://127.0.0.1:{STATEBUS_PORT},"
+                        f"statebus://127.0.0.1:{STATEBUS_PORT + 1}"),
+                    "CORDUM_SCHEDULER_SHARDS": "2",
+                    "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+                    "CORDUM_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                    "WORKER_ID": "smoke-w2", "WORKER_POOL": "tpu",
+                    "WORKER_TOPICS": "job.tpu.>,job.default,job.hello-pack.echo",
+                    "WORKER_CAPABILITIES": "tpu,echo",
+                    "WORKER_HEARTBEAT_INTERVAL": "1",
+                })
+                w2_log = open(os.path.join(logdir, "worker2.log"), "ab")
+                w2 = subprocess.Popen(
+                    [sys.executable, "-m", "cordum_tpu.cmd.worker"],
+                    env=w2_env, stdout=w2_log, stderr=w2_log, cwd=REPO)
+                procs.append(w2)
+                t0 = time.time()
+                while time.time() - t0 < 60:
+                    if "smoke-w2" in c.get("/api/v1/workers").json().get("workers", {}):
+                        break
+                    time.sleep(0.5)
+                assert "smoke-w2" in c.get("/api/v1/workers").json()["workers"]
+                drain_docs = []
+                for i in range(3):
+                    r = c.post("/api/v1/jobs", json={
+                        "topic": "job.tpu.generate",
+                        "payload": {"op": "llm.generate",
+                                    "tokens": list(range(2, 10)),
+                                    "max_new_tokens": 48,
+                                    "session_id": f"drain-conv-{i}"},
+                        "labels": {"preferred_worker_id": "smoke-w1"}})
+                    assert r.status_code == 202, r.text
+                    drain_docs.append(r.json())
+                # drain while the sessions are in flight
+                r = admin.post("/api/v1/workers/smoke-w1/drain",
+                               json={"reason": "smoke step 11"})
+                assert r.status_code == 202, r.text
+                finals = [wait_job(c, d["job_id"], "SUCCEEDED", 90)
+                          for d in drain_docs]
+                peer_finishes = 0
+                for d, doc in zip(drain_docs, finals):
+                    assert len(doc["result"]["tokens"]) == 48, doc["result"]
+                    events = [e.get("event") for e in
+                              c.get(f"/api/v1/jobs/{d['job_id']}?events=true")
+                              .json().get("events", [])]
+                    assert "cancelled" not in events, (d["job_id"], events)
+                    if doc.get("worker_id") == "smoke-w2":
+                        peer_finishes += 1
+                assert peer_finishes >= 1, (
+                    f"no session finished on the peer: {[f.get('worker_id') for f in finals]}")
+                # the drained worker beacons draining (then deregisters) and
+                # its process exits on its own
+                t0 = time.time()
+                w1_gone = False
+                while time.time() - t0 < 60:
+                    ws = c.get("/api/v1/workers").json().get("workers", {})
+                    hb = ws.get("smoke-w1")
+                    if hb is None or hb.get("draining"):
+                        w1_gone = True
+                        break
+                    time.sleep(0.5)
+                assert w1_gone, "smoke-w1 never beaconed draining"
+                # the fleet keeps serving: a fresh session completes on w2
+                r = c.post("/api/v1/jobs", json={
+                    "topic": "job.tpu.generate",
+                    "payload": {"op": "llm.generate", "tokens": [3, 1, 4],
+                                "max_new_tokens": 8,
+                                "session_id": "post-drain-conv"}})
+                doc = wait_job(c, r.json()["job_id"], "SUCCEEDED", 60)
+                assert doc.get("worker_id") == "smoke-w2", doc.get("worker_id")
+                log(f"11. drain/failover: 3 sessions survived the drain "
+                    f"({peer_finishes} finished on smoke-w2), zero CANCELLED, "
+                    "post-drain traffic serves on the peer")
+            else:
+                log("11. drain/failover: skipped (external deployment)")
 
         log("PASS")
         return 0
