@@ -353,6 +353,20 @@ def cmd_capacity(args) -> None:
     print(render_capacity_table(doc))
 
 
+def cmd_admission(args) -> None:
+    """Live admission-controller state (GET /api/v1/admission): per-(op,
+    class) headroom against measured capacity, the current brownout tier,
+    per-tenant token-bucket levels, shed counts (docs/ADMISSION.md)."""
+    from .controlplane.gateway.admission import render_admission_table
+
+    with _client() as c:
+        doc = _check(c.get("/api/v1/admission"))
+    if args.json:
+        _print(doc)
+        return
+    print(render_admission_table(doc))
+
+
 def cmd_drain(args) -> None:
     """Gracefully drain a worker: sessions live-migrate to peers (scheduler
     requeue as the fallback), per-job work finishes, then it exits —
@@ -526,6 +540,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="fleet op x worker throughput matrix (GET /api/v1/capacity)")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_capacity)
+
+    sp = sub.add_parser(
+        "admission",
+        help="live admission-controller state: headroom, brownout tier, "
+             "tenant buckets (GET /api/v1/admission)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_admission)
 
     sp = sub.add_parser(
         "top", help="live fleet telemetry table (GET /api/v1/fleet)")

@@ -33,18 +33,22 @@ async def main() -> None:
     wf_store = WorkflowStore(kv)
     wf_engine = WorkflowEngine(store=wf_store, bus=bus, mem=mem, schemas=schemas,
                                configsvc=configsvc, instance_id="gateway-wf")
-    # SLO objectives for the fleet telemetry plane come from the pools.yaml
-    # slo: stanza; an unreadable pool file must not stop the gateway
+    # SLO objectives + admission-control config come from the pools.yaml
+    # slo:/admission: stanzas; an unreadable pool file must not stop the
+    # gateway (it just runs without burn tracking or load shedding)
     try:
         from ..infra.config import load_pool_config
 
-        slo_config = load_pool_config(cfg.pool_config_path).slo
+        _pool_cfg = load_pool_config(cfg.pool_config_path)
+        slo_config = _pool_cfg.slo
+        admission_config = _pool_cfg.admission
     except Exception as e:  # noqa: BLE001 - telemetry config is best-effort
         from ..infra import logging as logx
 
         logx.warn("pool config unreadable; fleet SLO tracking disabled",
                   path=cfg.pool_config_path, err=str(e))
         slo_config = {}
+        admission_config = {}
     admin_keys = [k for k in os.environ.get("CORDUM_ADMIN_KEYS", "").split(",") if k]
     # CORDUM_KEY_TENANTS="key1:tenantA,key2:tenantB" scopes keys to tenants
     key_tenants: dict[str, str] = {}
@@ -65,6 +69,7 @@ async def main() -> None:
         max_concurrent_runs=_boot.env_int("MAX_CONCURRENT_RUNS", 0),
         scheduler_shards=cfg.scheduler_shards,
         slo_config=slo_config,
+        admission_config=admission_config,
         # tail-based trace retention: < 1.0 keeps every slower-than-p95
         # trace and samples the fast rest (docs/OBSERVABILITY.md)
         trace_keep_fraction=_boot.env_float("CORDUM_TRACE_KEEP_FRACTION", 1.0),

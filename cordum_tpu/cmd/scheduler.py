@@ -26,7 +26,10 @@ from ..controlplane.scheduler.reconciler import (
     WorkerFailover,
 )
 from ..controlplane.scheduler.safety_client import SafetyClient
-from ..controlplane.scheduler.strategy import LeastLoadedStrategy
+from ..controlplane.scheduler.strategy import (
+    LeastLoadedStrategy,
+    ThroughputAwareStrategy,
+)
 from ..infra import logging as logx
 from ..infra.configsvc import ConfigService
 from ..infra.jobstore import JobStore
@@ -64,7 +67,20 @@ async def main() -> None:
     timeouts = load_timeouts(cfg.timeout_config_path)
     # one registry shared by strategy (session-affinity counters) and engine
     metrics = Metrics()
-    strategy = LeastLoadedStrategy(registry, pool_cfg, metrics=metrics)
+    # capacity-aware routing (docs/ADMISSION.md §Routing) is the default:
+    # the strategy consumes the workers' capacity beacons and degrades to
+    # exact LeastLoaded behavior while the matrix is cold/stale.
+    # SCHEDULER_STRATEGY=least_loaded opts out.
+    capacity_view = None
+    if os.environ.get("SCHEDULER_STRATEGY", "throughput") == "least_loaded":
+        strategy = LeastLoadedStrategy(registry, pool_cfg, metrics=metrics)
+    else:
+        from ..obs.capacity import CapacityView
+
+        capacity_view = CapacityView()
+        strategy = ThroughputAwareStrategy(
+            registry, pool_cfg, capacity=capacity_view, metrics=metrics
+        )
     if shard_count <= 0:  # flag/env unset: pools.yaml scheduler.shards
         shard_count = pool_cfg.scheduler_shards
 
@@ -142,6 +158,8 @@ async def main() -> None:
     timeouts_doc = await asyncio.to_thread(_load_yaml, cfg.timeout_config_path)
     await overlay.bootstrap(pools_doc, timeouts_doc)
 
+    if capacity_view is not None:
+        await capacity_view.start(bus)
     await engine.start()
     await reconciler.start()
     await replayer.start()
@@ -163,6 +181,8 @@ async def main() -> None:
         await replayer.stop()
         await reconciler.stop()
         await engine.stop()
+        if capacity_view is not None:
+            await capacity_view.stop()
         await conn.close()
 
 

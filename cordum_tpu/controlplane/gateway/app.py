@@ -65,6 +65,7 @@ from ...protocol.types import (
     LABEL_APPROVAL_GRANTED,
     LABEL_BATCH_KEY,
     LABEL_BUS_MSG_ID,
+    LABEL_OP,
     LABEL_SECRETS_PRESENT,
     LABEL_SESSION_KEY,
     PolicyCheckRequest,
@@ -78,6 +79,7 @@ from ...workflow.engine import Engine as WorkflowEngine, WorkflowError
 from ...workflow.models import Workflow
 from ...workflow.store import WorkflowStore
 from ..safetykernel.kernel import SafetyKernel
+from .admission import AdmissionController
 from .auth import AuthProvider, BasicAuthProvider, Principal, TokenBucket
 
 MAX_BODY_BYTES = 2 * 1024 * 1024  # 2 MiB submit cap (reference gateway.go:1757)
@@ -86,6 +88,15 @@ MAX_BULK_JOBS = 256  # jobs per POST /api/v1/jobs:batch
 
 def _err(status: int, message: str) -> web.Response:
     return web.json_response({"error": message}, status=status)
+
+
+def _retry_after_headers(status: int, doc: dict) -> Optional[dict[str, str]]:
+    """429 responses carry an honest Retry-After so SDK clients back off
+    instead of retrying immediately (docs/ADMISSION.md)."""
+    if status != 429:
+        return None
+    retry = float(doc.get("retry_after_s") or 0.25)
+    return {"Retry-After": f"{retry:.3f}"}
 
 
 class Gateway:
@@ -111,6 +122,7 @@ class Gateway:
         instance_id: str = "gateway-0",
         scheduler_shards: int = 1,
         slo_config: Optional[dict] = None,
+        admission_config: Optional[dict] = None,
         telemetry: bool = True,
         trace_keep_fraction: float = 1.0,
     ):
@@ -152,6 +164,17 @@ class Gateway:
             slo_config or {}, metrics=self.metrics
         )
         self.profiler = RuntimeProfiler(self.metrics, service="gateway")
+        # overload resilience (docs/ADMISSION.md): the admission controller
+        # sheds analytically against the capacity matrix + SLO burn rates
+        # the aggregator/tracker above already maintain, and beacons
+        # pressure to the scheduler's preemption governor.  No admission:
+        # stanza → disabled (pure pass-through).
+        self.admission = AdmissionController(
+            fleet=self.fleet, slo_tracker=self.slo_tracker,
+            config=admission_config, metrics=self.metrics, bus=bus,
+            instance_id=instance_id,
+        )
+        self._admission_task: Optional[asyncio.Task] = None
         self._telemetry_enabled = telemetry
         self.telemetry = TelemetryExporter(
             "gateway", bus, self.metrics, instance_id=instance_id,
@@ -248,6 +271,7 @@ class Gateway:
         r.add_get(f"{v1}/traces/{{trace_id}}", self.get_trace)
         r.add_get(f"{v1}/fleet", self.get_fleet)
         r.add_get(f"{v1}/capacity", self.get_capacity)
+        r.add_get(f"{v1}/admission", self.get_admission)
         r.add_get(f"{v1}/workers", self.get_workers)
         r.add_post(f"{v1}/workers/{{worker_id}}/drain", self.drain_worker)
         r.add_get(f"{v1}/status", self.get_status)
@@ -273,7 +297,13 @@ class Gateway:
     async def _middleware(self, request: web.Request, handler):
         t0 = time.perf_counter()
         if not self.rate.allow(request.headers.get("X-Api-Key", request.remote or "")):
-            return _err(429, "rate limited")
+            # honest Retry-After: one token accrues in 1/rps seconds
+            retry = max(0.25, 1.0 / self.rate.rps) if self.rate.rps > 0 else 1.0
+            self.metrics.gateway_shed.inc(reason="rate_limit", job_class="unknown")
+            return web.json_response(
+                {"error": "rate limited", "retry_after_s": round(retry, 3)},
+                status=429, headers={"Retry-After": f"{retry:.3f}"},
+            )
         if request.path in ("/healthz", "/metrics", "/") or request.path.startswith("/ui"):
             request["principal"] = Principal()
             return await handler(request)
@@ -326,6 +356,8 @@ class Gateway:
             await self.fleet.start()
             await self.telemetry.start()
             await self.profiler.start()
+        if self.admission.enabled and self._admission_task is None:
+            self._admission_task = asyncio.ensure_future(self._admission_loop())
         if self.registry is not None:
             self._subs.append(await self.bus.subscribe(subj.HEARTBEAT, self._tap_heartbeat))
         self._runner = web.AppRunner(self.app)
@@ -338,6 +370,10 @@ class Gateway:
         for s in self._subs:
             s.unsubscribe()
         self._subs = []
+        if self._admission_task is not None:
+            task, self._admission_task = self._admission_task, None
+            task.cancel()
+            await logx.join_task(task, name="admission-refresh")
         if self._telemetry_enabled:
             await self.profiler.stop()
             await self.telemetry.stop()
@@ -348,6 +384,18 @@ class Gateway:
         if self._runner:
             await self._runner.cleanup()
             self._runner = None
+
+    async def _admission_loop(self) -> None:
+        """Periodic admission refresh: rolls offered rates, re-reads the
+        capacity matrix + SLO burn states, and beacons pressure to the
+        scheduler's preemption governor (docs/ADMISSION.md)."""
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                self.admission.refresh()
+                await self.admission.publish_pressure()
+            except Exception as e:  # noqa: BLE001 - refresh must never die silently
+                logx.warn("admission refresh failed", err=str(e))
 
     async def _tap_heartbeat(self, subject: str, pkt: BusPacket) -> None:
         if pkt.heartbeat and self.registry is not None:
@@ -400,7 +448,8 @@ class Gateway:
             body, principal,
             idempotency_header=request.headers.get("Idempotency-Key", ""),
         )
-        return web.json_response(doc, status=status)
+        return web.json_response(doc, status=status,
+                                 headers=_retry_after_headers(status, doc))
 
     async def submit_jobs_bulk(self, request: web.Request) -> web.Response:
         """``POST /api/v1/jobs:batch`` — submit many jobs in one round trip
@@ -418,19 +467,28 @@ class Gateway:
             return _err(400, f"too many jobs in one batch (max {MAX_BULK_JOBS})")
         out: list[dict[str, Any]] = []
         accepted = 0
+        retry_after = 0.0
         for doc in jobs:
             if not isinstance(doc, dict):
                 out.append({"error": "job body must be an object", "status": 400})
                 continue
             status, res = await self._submit_one(doc, principal)
             if status >= 400:
-                out.append({"error": str(res.get("error", "rejected")), "status": status})
+                entry = {"error": str(res.get("error", "rejected")), "status": status}
+                if status == 429:
+                    entry["retry_after_s"] = res.get("retry_after_s", 0.0)
+                    retry_after = max(
+                        retry_after, float(res.get("retry_after_s") or 0.0))
+                out.append(entry)
             else:
                 accepted += 1
                 out.append(res)
+        headers = (
+            {"Retry-After": f"{retry_after:.3f}"} if retry_after > 0 else None
+        )
         return web.json_response(
             {"jobs": out, "accepted": accepted, "rejected": len(out) - accepted},
-            status=202 if accepted else 400,
+            status=202 if accepted else 400, headers=headers,
         )
 
     async def _submit_one(
@@ -449,6 +507,22 @@ class Gateway:
             # key-derived admin status, not the forgeable role header
             # (reference RequireTenantAccess, basic_auth.go:100-122)
             return 403, {"error": f"tenant {tenant!r} not permitted for this principal"}
+        # capacity-aware admission (docs/ADMISSION.md): shed BEFORE minting
+        # state — a shed submission costs no KV writes and no bus traffic.
+        # The op keys into the fleet throughput matrix the same way the
+        # worker profiles it (payload op, else the topic).
+        op = ""
+        if isinstance(payload, dict):
+            op = str(payload.get("op") or "")
+        op = op or topic
+        job_class = str(body.get("priority", "BATCH"))
+        verdict = self.admission.admit(op=op, job_class=job_class, tenant=tenant)
+        if not verdict.allowed:
+            return 429, {
+                "error": f"shed: {verdict.reason}",
+                "reason": verdict.reason,
+                "retry_after_s": verdict.retry_after_s,
+            }
         job_id = str(body.get("job_id") or new_id())
 
         idem = str(body.get("idempotency_key") or idempotency_header)
@@ -469,6 +543,10 @@ class Gateway:
         skey = payload_session_key(payload)
         if skey and LABEL_SESSION_KEY not in labels:
             labels[LABEL_SESSION_KEY] = skey
+        # the resolved op rides as a label so capacity-aware consumers (the
+        # ThroughputAwareStrategy's matrix lookup) never read the payload
+        if LABEL_OP not in labels:
+            labels[LABEL_OP] = op
         meta_doc = body.get("metadata") or {}
         metadata = JobMetadata(
             capability=str(meta_doc.get("capability", "")),
@@ -1382,6 +1460,12 @@ class Gateway:
         folded from the workers' capacity beacons (`cordumctl capacity`;
         the heterogeneity-aware strategy's read-only input)."""
         return web.json_response(self.fleet.capacity_doc())
+
+    async def get_admission(self, request: web.Request) -> web.Response:
+        """``GET /api/v1/admission`` — live admission-controller state:
+        per-(op, class) headroom, current brownout tier, per-tenant bucket
+        levels (`cordumctl admission`, docs/ADMISSION.md)."""
+        return web.json_response(self.admission.doc())
 
     async def get_metrics(self, request: web.Request) -> web.Response:
         # ?scope=fleet: the aggregator's fleet-merged exposition (counters/

@@ -27,11 +27,12 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import random
 import time
 from typing import AsyncIterator, Optional
 
 from ...infra import codec, logging as logx
-from ...infra.bus import Bus, RetryAfter
+from ...infra.bus import Bus, MAX_NAK_DELAY_S, RetryAfter
 from ...infra.configsvc import ConfigService
 from ...infra.jobstore import JobStore, MetaSnapshot, SafetyDecisionRecord, meta_key
 from ...infra.metrics import Metrics
@@ -48,6 +49,7 @@ from ...protocol.types import (
     Decision,
     ENV_EFFECTIVE_CONFIG,
     ERROR_SESSION_REQUEUE,
+    JobPreempt,
     JobRequest,
     JobResult,
     JobState,
@@ -65,6 +67,17 @@ DEFAULT_MAX_ATTEMPTS = 5
 DEFAULT_SUBMIT_CONCURRENCY = 64
 ENV_POLICY_CONSTRAINTS = "CORDUM_POLICY_CONSTRAINTS"
 ENV_MAX_CHIPS = "CORDUM_MAX_CHIPS"
+# tenant-concurrency NAK backoff base: doubles per redelivery (±25% jitter)
+# so a tenant burst de-synchronizes instead of NAKing in lockstep
+TENANT_NAK_BASE_S = 0.25
+# batch preemption under interactive SLO pressure (docs/ADMISSION.md):
+# at most this many BATCH jobs preempted per pressure beacon, each held
+# off this long (jittered) before its attempts-exempt re-dispatch, and
+# never re-preempted within the cooldown
+MAX_PREEMPTIONS_PER_PRESSURE = 8
+PREEMPT_HOLDOFF_S = 1.0
+PREEMPT_COOLDOWN_S = 5.0
+PREEMPTED_REASON = "preempted"
 
 _INFLIGHT_STATES = (
     JobState.SCHEDULED.value,
@@ -84,15 +97,16 @@ class _SubmitItem:
 
     __slots__ = (
         "req", "trace_id", "parent_span_id", "fut",
-        "snap", "pending", "resp", "sched_sp", "target",
+        "snap", "pending", "resp", "sched_sp", "target", "redeliveries",
     )
 
     def __init__(self, req: JobRequest, trace_id: str, parent_span_id: str,
-                 fut: "asyncio.Future[None]") -> None:
+                 fut: "asyncio.Future[None]", redeliveries: int = 0) -> None:
         self.req = req
         self.trace_id = trace_id
         self.parent_span_id = parent_span_id
         self.fut = fut
+        self.redeliveries = redeliveries
         self.snap: Optional[MetaSnapshot] = None
         self.pending: dict[str, str] = {}
         self.resp = None
@@ -198,6 +212,14 @@ class Engine:
         # swamp the job store; after a scheduler restart a failover simply
         # replays from the prompt (same tokens, more decode work).
         self._stream_tokens: dict[str, list[int]] = {}
+        # batch preemption under interactive SLO pressure (docs/ADMISSION.md
+        # §Preemption): the gateway admission controller's pressure beacons
+        # trigger a bounded scan that asks workers to hand back dispatched
+        # BATCH jobs; preempted jobs re-dispatch attempts-exempt after a
+        # jittered hold-off
+        self._preempt_cooldown: dict[str, float] = {}
+        self._preempt_tasks: set[asyncio.Task] = set()
+        self._preempt_scan: Optional[asyncio.Task] = None
         # kv round-trip accounting (cordum_kv_roundtrips_total{op}) for the
         # store this engine drives — the bench's kv_roundtrips_per_job source
         job_store.kv.bind_metrics(self.metrics)
@@ -213,6 +235,7 @@ class Engine:
             await self.bus.subscribe(subj.CANCEL, self._on_cancel, queue=subj.QUEUE_SCHEDULER),
             await self.bus.subscribe(subj.HEARTBEAT, self._on_heartbeat),
             await self.bus.subscribe(subj.PROGRESS, self._on_progress),
+            await self.bus.subscribe(subj.ADMISSION_PRESSURE, self._on_pressure),
         ]
         if self.shard_count > 1:
             # this shard's slice of the keyspace: its own partition subjects
@@ -250,6 +273,15 @@ class Engine:
         self._result_q = []
         self._snap_cache.clear()
         self._stream_tokens.clear()
+        if self._preempt_scan is not None:
+            self._preempt_scan.cancel()
+            await logx.join_task(self._preempt_scan, name="preempt-scan")
+            self._preempt_scan = None
+        for t in list(self._preempt_tasks):
+            t.cancel()
+            await logx.join_task(t, name="preempt-redispatch")
+        self._preempt_tasks.clear()
+        self._preempt_cooldown.clear()
 
     # ------------------------------------------------------------------
     def owns(self, job_id: str) -> bool:
@@ -333,6 +365,81 @@ class Engine:
             await self.job_store.append_event(c.job_id, "cancelled", reason=c.reason)
 
     # ------------------------------------------------------------------
+    # batch preemption (docs/ADMISSION.md §Preemption): the telemetry
+    # plane changing the data plane — interactive SLO pressure requeues
+    # dispatched BATCH work instead of letting interactive p99 collapse
+    # ------------------------------------------------------------------
+    async def _on_pressure(self, subject: str, pkt: BusPacket) -> None:
+        ap = pkt.admission_pressure
+        if ap is None or not ap.preempt_batch:
+            return
+        if self._preempt_scan is not None and not self._preempt_scan.done():
+            return  # single-flight: one scan per beacon at most
+        self._preempt_scan = asyncio.ensure_future(self._preempt_batch_jobs())
+
+    async def _preempt_batch_jobs(self) -> int:
+        """Scan owned DISPATCHED/RUNNING BATCH jobs and ask their workers to
+        hand them back (bounded per beacon, per-job cooldown).  Workers
+        requeue where that is safe (queued intake slots, serving sessions);
+        a handler already executing simply ignores the request."""
+        now = time.monotonic()
+        self._preempt_cooldown = {
+            jid: t for jid, t in self._preempt_cooldown.items()
+            if now - t < PREEMPT_COOLDOWN_S
+        }
+        n = 0
+        for state in (JobState.RUNNING.value, JobState.DISPATCHED.value):
+            if n >= MAX_PREEMPTIONS_PER_PRESSURE:
+                break
+            for jid in await self.job_store.list_by_state(state, 128):
+                if n >= MAX_PREEMPTIONS_PER_PRESSURE:
+                    break
+                if not self.owns(jid) or jid in self._preempt_cooldown:
+                    continue
+                meta = await self.job_store.get_meta(jid)
+                if (meta.get("priority") or "BATCH") != "BATCH":
+                    continue  # only BATCH yields to interactive pressure
+                if meta.get("state") != state:
+                    continue  # moved on concurrently
+                await self.preempt_job(jid)
+                n += 1
+        return n
+
+    async def preempt_job(self, job_id: str, *, reason: str = "slo_pressure") -> None:
+        """Fan out a :class:`JobPreempt` for one BATCH job.  Fire-and-forget:
+        the holding worker answers with a non-terminal ``SESSION_REQUEUE``
+        result (reason ``preempted``) when it can yield the job."""
+        self._preempt_cooldown[job_id] = time.monotonic()
+        self.metrics.preemptions.inc(reason="requested")
+        await self.bus.publish(
+            subj.PREEMPT,
+            BusPacket.wrap(
+                JobPreempt(job_id=job_id, reason=reason,
+                           requested_by=self.instance_id),
+                sender_id=self.instance_id,
+            ),
+        )
+
+    def _schedule_preempt_redispatch(self, job_id: str) -> None:
+        """Attempts-exempt re-dispatch of a preempted job after a jittered
+        hold-off — long enough for the interactive burst to drain ahead of
+        it, short enough that preemption never strands work (the replayer's
+        result-replay nudge backstops it regardless)."""
+        async def _redispatch() -> None:
+            await asyncio.sleep(
+                PREEMPT_HOLDOFF_S * (1.0 + random.uniform(-0.5, 0.5))
+            )
+            moved = await self.failover_job(
+                job_id, reason=PREEMPTED_REASON, count_attempt=False
+            )
+            if moved:
+                self.metrics.preemptions.inc(reason="redispatched")
+
+        t = asyncio.ensure_future(_redispatch())
+        self._preempt_tasks.add(t)
+        t.add_done_callback(self._preempt_tasks.discard)
+
+    # ------------------------------------------------------------------
     async def _on_submit(self, subject: str, pkt: BusPacket) -> None:
         req = pkt.job_request
         if req is None or not req.job_id or not req.topic:
@@ -349,14 +456,16 @@ class Engine:
                 # batch propagates to THIS delivery and drives redelivery)
                 fut: asyncio.Future[None] = asyncio.get_running_loop().create_future()
                 self._submit_q.append(
-                    _SubmitItem(req, pkt.trace_id, pkt.span_id, fut)
+                    _SubmitItem(req, pkt.trace_id, pkt.span_id, fut,
+                                pkt.redelivery_count)
                 )
                 self._submit_wake.set()
                 await fut
             else:
                 async with self._sem:
                     await self.handle_job_request(
-                        req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id
+                        req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id,
+                        redeliveries=pkt.redelivery_count,
                     )
         finally:
             self._inflight -= 1
@@ -520,7 +629,8 @@ class Engine:
         try:
             async with self._sem:
                 await self.handle_job_request(
-                    it.req, trace_id=it.trace_id, parent_span_id=it.parent_span_id
+                    it.req, trace_id=it.trace_id, parent_span_id=it.parent_span_id,
+                    redeliveries=it.redeliveries,
                 )
         except BaseException as e:
             if not it.fut.done():
@@ -554,6 +664,7 @@ class Engine:
             await self._post_decision(
                 it.req, it.resp, snap=it.snap, pending_fields=it.pending,
                 trace_id=it.sched_sp.trace_id, parent_span_id=it.sched_sp.span_id,
+                redeliveries=it.redeliveries,
             )
         except BaseException as e:
             await self._fail_item(it, e)
@@ -810,7 +921,8 @@ class Engine:
         return fields
 
     async def handle_job_request(
-        self, req: JobRequest, *, trace_id: str = "", parent_span_id: str = ""
+        self, req: JobRequest, *, trace_id: str = "", parent_span_id: str = "",
+        redeliveries: int = 0,
     ) -> None:
         if not await self.job_store.acquire_job_lock(req.job_id, self.instance_id, ttl_s=30.0):
             raise RetryAfter(0.05, f"job {req.job_id} locked")
@@ -878,14 +990,15 @@ class Engine:
                 parent_span_id=parent_span_id,
                 attrs={"job_id": req.job_id, "topic": req.topic},
             ):
-                await self.process_job(req, trace_id=trace_id, snap=snap)
+                await self.process_job(req, trace_id=trace_id, snap=snap,
+                                       redeliveries=redeliveries)
         finally:
             await self.job_store.release_job_lock(req.job_id, self.instance_id)
 
     # ------------------------------------------------------------------
     async def process_job(
         self, req: JobRequest, *, trace_id: str = "",
-        snap: Optional[MetaSnapshot] = None,
+        snap: Optional[MetaSnapshot] = None, redeliveries: int = 0,
     ) -> None:
         if snap is None:
             snap = await self.job_store.watch_meta(req.job_id)
@@ -908,6 +1021,7 @@ class Engine:
         await self._post_decision(
             req, resp, snap=snap, pending_fields=pending_fields,
             trace_id=trace_id or ptrace, parent_span_id=pspan,
+            redeliveries=redeliveries,
         )
 
     def _tenant_limit(self, req: JobRequest) -> int:
@@ -929,6 +1043,7 @@ class Engine:
         self, req: JobRequest, resp, *,
         snap: MetaSnapshot, pending_fields: dict[str, str],
         trace_id: str = "", parent_span_id: str = "",
+        redeliveries: int = 0,
     ) -> None:
         """Everything after the safety check: decision branches, tenant
         gate, deadline, attempts guard, strategy pick, dispatch.  Shared by
@@ -976,7 +1091,15 @@ class Engine:
         if limit and req.tenant_id:
             active = await self.job_store.tenant_active_count(req.tenant_id)
             if active >= limit:
-                raise RetryAfter(0.25, f"tenant {req.tenant_id} at concurrency limit {limit}")
+                # exponential NAK backoff with ±25% jitter per redelivery:
+                # a tenant burst spreads out instead of resonating as a
+                # synchronized retry storm (capped by MAX_NAK_DELAY_S)
+                delay = min(MAX_NAK_DELAY_S,
+                            TENANT_NAK_BASE_S * (2 ** max(0, redeliveries)))
+                delay *= 1.0 + random.uniform(-0.25, 0.25)
+                raise RetryAfter(
+                    delay, f"tenant {req.tenant_id} at concurrency limit {limit}"
+                )
         if req.tenant_id:
             extra_ops += self.job_store.tenant_active_add_ops(req.tenant_id, req.job_id)
 
@@ -1142,7 +1265,10 @@ class Engine:
         self.metrics.inflight_nudges.inc()
         return True
 
-    async def failover_job(self, job_id: str, *, reason: str = "worker_dead") -> bool:
+    async def failover_job(
+        self, job_id: str, *, reason: str = "worker_dead",
+        count_attempt: bool = True,
+    ) -> bool:
         """Re-dispatch an in-flight job to a NEW worker after its old one
         died or handed it back (``SESSION_REQUEUE``) — the serving-session
         crash-failover leg (docs/SERVING.md §Migration, drain, and
@@ -1163,7 +1289,12 @@ class Engine:
             req = await self.job_store.get_request(job_id)
             if req is None:
                 return False
-            attempts = int(snap.get("attempts", "0") or "0") + 1
+            # preemption re-dispatches are attempts-exempt: yielding to
+            # interactive pressure is the control plane's choice, not the
+            # job's failure, so it must never burn the job toward the DLQ
+            attempts = int(snap.get("attempts", "0") or "0") + (
+                1 if count_attempt else 0
+            )
             if attempts > self.max_attempts:
                 self._stream_tokens.pop(job_id, None)
                 await self._fail_to_dlq(
@@ -1334,6 +1465,13 @@ class Engine:
             state = JobState.FAILED
         if state not in TERMINAL_STATES:
             if res.error_code == ERROR_SESSION_REQUEUE:
+                if res.error_message.startswith(PREEMPTED_REASON):
+                    # preemption: the worker yielded the job to interactive
+                    # pressure — count it, hold it off briefly, then
+                    # re-dispatch attempts-exempt (never FAILED/CANCELLED)
+                    self.metrics.preemptions.inc(reason="requeued")
+                    self._schedule_preempt_redispatch(res.job_id)
+                    return
                 # a worker handed the job back (drain without a migration
                 # target, crashed decode loop): re-dispatch it instead of
                 # recording anything terminal — bounded by the attempts cap

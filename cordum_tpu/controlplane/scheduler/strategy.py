@@ -43,6 +43,7 @@ from ...protocol.types import (
     Heartbeat,
     JobRequest,
     LABEL_BATCH_KEY,
+    LABEL_OP,
     LABEL_SESSION_KEY,
 )
 
@@ -436,3 +437,109 @@ class LeastLoadedStrategy(Strategy):
                 self._record_affinity(session_akey, best_worker)
             return direct_subject(best_worker)
         return req.topic
+
+
+class ThroughputAwareStrategy(LeastLoadedStrategy):
+    """Heterogeneity-aware routing on the measured throughput matrix
+    (Gavel, PAPERS.md; ROADMAP item 1 — the capacity observatory's first
+    data-plane consumer).
+
+    Each job carrying the gateway-stamped ``cordum.op`` label routes to
+    eligible workers in proportion to their measured **steady-state
+    headroom** for that op: ``items/s × (1 − load_fraction)`` from the
+    :class:`~cordum_tpu.obs.capacity.CapacityView`, distributed by smooth
+    weighted round-robin (nginx-style: deterministic, starvation-free — a
+    3× faster worker gets exactly 3× the jobs).  Workers the matrix has
+    not measured for the op get the median measured weight so they receive
+    traffic and *become* measured.
+
+    Degradation ladder (each step is exact LeastLoaded behavior):
+    affinity/hint/placement-labeled jobs delegate wholesale (sticky
+    sessions beat throughput); ops with NO fresh measured row fall back to
+    the LeastLoaded scan; an absent CapacityView disables the override
+    entirely.
+    """
+
+    def __init__(self, registry: WorkerRegistry, pool_config: PoolConfig, *,
+                 capacity=None, native: bool = True, metrics=None):
+        super().__init__(registry, pool_config, native=native, metrics=metrics)
+        self.capacity = capacity
+        # smooth-WRR state per op: worker → current credit
+        self._wrr: dict[str, dict[str, float]] = {}
+        self.routed_measured = 0
+        self.routed_fallback = 0
+
+    _ROUTING_LABELS = ("preferred_worker_id", "preferred_pool",
+                       LABEL_BATCH_KEY, LABEL_SESSION_KEY)
+
+    def pick_subjects(self, reqs: list[JobRequest]) -> list[str]:
+        # no shape memoization: the WRR must distribute jobs WITHIN a tick
+        # (the parent's one-pick-per-shape would send a whole tick batch to
+        # one worker, defeating proportional routing)
+        return [self.pick_subject(r) for r in reqs]
+
+    def pick_subject(self, req: JobRequest) -> str:
+        labels = req.labels or {}
+        if self.capacity is None or any(
+            labels.get(k) for k in self._ROUTING_LABELS
+        ) or any(k.startswith("placement.") for k in labels):
+            return super().pick_subject(req)
+        op = labels.get(LABEL_OP, "")
+        if not op:
+            return super().pick_subject(req)
+        pools = self._pools_for_topic(req.topic)
+        if not pools:
+            return req.topic
+        job_requires = list(req.metadata.requires) if req.metadata else []
+        candidates: list[Heartbeat] = []
+        for hb in self.registry.snapshot().values():
+            pool = next((p for p in pools if p.name == hb.pool), None)
+            if pool is None:
+                continue
+            if not worker_satisfies(hb, pool, job_requires):
+                continue
+            if is_overloaded(hb):
+                continue
+            candidates.append(hb)
+        if not candidates:
+            return req.topic
+        measured = {
+            hb.worker_id: self.capacity.rate(hb.worker_id, op)
+            for hb in candidates
+        }
+        rates = sorted(r for r in measured.values() if r > 0)
+        if not rates:
+            # matrix empty/stale for this op: exact LeastLoaded behavior
+            self.routed_fallback += 1
+            return super().pick_subject(req)
+        median = rates[len(rates) // 2]
+        weights: dict[str, float] = {}
+        for hb in candidates:
+            base = measured[hb.worker_id] or median
+            if hb.max_parallel_jobs > 0:
+                load_frac = min(1.0, hb.active_jobs / hb.max_parallel_jobs)
+            else:
+                load_frac = min(1.0, load_score(hb) / 16.0)
+            weights[hb.worker_id] = base * max(0.1, 1.0 - load_frac)
+        winner = self._wrr_pick(op, weights)
+        self.routed_measured += 1
+        return direct_subject(winner)
+
+    def _wrr_pick(self, op: str, weights: dict[str, float]) -> str:
+        """Smooth weighted round-robin: add each worker's weight to its
+        credit, pick the max, subtract the total — selections converge to
+        exact weight proportions with no randomness and no starvation."""
+        if len(self._wrr) > 1024:
+            self._wrr.clear()  # unbounded-op-space guard
+        state = self._wrr.setdefault(op, {})
+        for gone in [w for w in state if w not in weights]:
+            del state[gone]
+        total = sum(weights.values())
+        best, best_credit = "", float("-inf")
+        for wid, w in sorted(weights.items()):
+            credit = state.get(wid, 0.0) + w
+            state[wid] = credit
+            if credit > best_credit:
+                best, best_credit = wid, credit
+        state[best] -= total
+        return best

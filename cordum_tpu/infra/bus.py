@@ -301,6 +301,9 @@ class LoopbackBus(Bus):
                     log.warning("dropping message on %s after %d attempts", subject, attempt)
                     return
                 attempt += 1
+                # handlers read this to back off exponentially (the tenant-
+                # concurrency NAK path) instead of NAKing at a fixed cadence
+                pkt.redelivery_count = attempt - 1
                 await asyncio.sleep(min(max(ra.delay_s, 0.0), MAX_NAK_DELAY_S))
             except Exception:
                 log.exception("handler error on %s (acked; no redelivery)", subject)

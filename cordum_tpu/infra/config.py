@@ -100,6 +100,10 @@ class PoolConfig:
     # latency_target, availability_target}) consumed by the gateway's
     # SLOTracker (cordum_tpu/obs/slo.py)
     slo: dict = field(default_factory=dict)
+    # admission: gateway capacity-aware admission control (per-tenant
+    # quotas, headroom shedding, brownout ladder) consumed by the gateway's
+    # AdmissionController (docs/ADMISSION.md)
+    admission: dict = field(default_factory=dict)
 
     def pools_for_topic(self, topic: str) -> list[Pool]:
         names = self.topics.get(topic)
@@ -143,6 +147,7 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
     cfg.scheduler_shards = max(1, int((doc.get("scheduler") or {}).get("shards") or 1))
     cfg.statebus = dict(doc.get("statebus") or {})
     cfg.slo = dict(doc.get("slo") or {})
+    cfg.admission = dict(doc.get("admission") or {})
     return cfg
 
 

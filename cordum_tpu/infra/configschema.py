@@ -110,6 +110,46 @@ POOLS_SCHEMA: dict[str, Any] = {
                 "additionalProperties": False,
             },
         },
+        # capacity-aware gateway admission control (docs/ADMISSION.md): the
+        # AdmissionController sheds analytically against the measured fleet
+        # capacity matrix, enforces per-tenant token-bucket quotas, and runs
+        # the brownout ladder off the interactive SLO burn signal
+        "admission": {
+            "type": "object",
+            "properties": {
+                "enabled": {"type": "boolean"},
+                # admit up to this fraction of measured steady-state capacity
+                "safety_factor": {
+                    "type": "number", "exclusiveMinimum": 0, "maximum": 1,
+                },
+                # offered-rate EWMA smoothing (0 < alpha <= 1)
+                "smoothing_alpha": {
+                    "type": "number", "exclusiveMinimum": 0, "maximum": 1,
+                },
+                # cold/stale-matrix fallback: shed batch past this fleet
+                # scheduler backlog; interactive sheds at the bound below
+                "queue_depth_limit": {"type": "integer", "minimum": 1},
+                "interactive_queue_bound": {"type": "integer", "minimum": 1},
+                "min_retry_after_s": _NONNEG,
+                "max_retry_after_s": _NONNEG,
+                # ops shed at brownout tier 2 (best-effort work)
+                "best_effort_ops": _STR_LIST,
+                # per-tenant token buckets; rate_rps 0 = unlimited.  The
+                # "default" entry applies to tenants with no explicit stanza.
+                "tenants": {
+                    "type": "object",
+                    "additionalProperties": {
+                        "type": "object",
+                        "properties": {
+                            "rate_rps": _NONNEG,
+                            "burst": _NONNEG,
+                        },
+                        "additionalProperties": False,
+                    },
+                },
+            },
+            "additionalProperties": False,
+        },
         # tolerated here so one file can carry pools + reconciler (dev mode)
         "reconciler": {"type": "object"},
     },

@@ -1,0 +1,156 @@
+"""Multi-tenant open-loop traffic generator — the storm harness's engine.
+
+Models the "millions of users" scenario in miniature (ROADMAP item 1;
+docs/ADMISSION.md §Storm harness): each :class:`TenantSpec` drives an
+**open-loop** Poisson arrival process of *sessions* — arrivals do not wait
+for completions, so offered load stays at the configured rate no matter how
+slow the system gets (the property that makes overload benchmarks honest;
+a closed-loop driver self-throttles and hides collapse).
+
+Per tenant the rate can be shaped:
+
+* **bursts** — every ``burst_every_s`` the rate multiplies by
+  ``burst_factor`` for ``burst_len_s`` (retry-storm / thundering-herd);
+* **diurnal ramp** — a sine of period ``diurnal_period_s`` and relative
+  amplitude ``diurnal_amp`` modulates the base rate (the day/night curve,
+  compressed);
+* **sessions with think time** — a session submits ``session_turns`` jobs
+  spaced ``think_time_s`` apart (conversation turns), all sharing one
+  ``session_id`` so scheduler session affinity engages.
+
+The generator owns arrivals ONLY.  The caller's ``submit`` callback does
+the actual work (drive the gateway admission path, publish to the bus, ...)
+and returns quickly; completion/latency tracking stays with the caller.
+Determinism: ``rng`` is an injectable ``random.Random`` and all pacing uses
+the injectable monotonic ``clock``.
+"""
+from __future__ import annotations
+
+import asyncio
+import math
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional
+
+# submit(spec, session_id, turn_index) -> awaited per arrival; the return
+# value is ignored by the generator (the caller tracks outcomes)
+SubmitFn = Callable[["TenantSpec", str, int], Awaitable[Any]]
+
+
+@dataclass
+class TenantSpec:
+    """One tenant's traffic shape."""
+
+    name: str
+    job_class: str = "BATCH"  # JobRequest.priority
+    op: str = "echo"  # payload op (keys into the capacity matrix)
+    topic: str = "job.storm"
+    rate_rps: float = 10.0  # mean session arrival rate
+    burst_factor: float = 1.0
+    burst_every_s: float = 0.0  # 0 = no bursts
+    burst_len_s: float = 1.0
+    diurnal_period_s: float = 0.0  # 0 = flat
+    diurnal_amp: float = 0.0  # relative amplitude (0..1)
+    session_turns: int = 1  # jobs per session
+    think_time_s: float = 0.0  # gap between a session's turns
+    payload: dict = field(default_factory=dict)
+
+    def rate_at(self, t: float) -> float:
+        """Offered session rate at elapsed time ``t`` (bursts + diurnal)."""
+        rate = self.rate_rps
+        if self.diurnal_period_s > 0 and self.diurnal_amp > 0:
+            rate *= 1.0 + self.diurnal_amp * math.sin(
+                2 * math.pi * t / self.diurnal_period_s
+            )
+        if self.burst_every_s > 0 and (
+            t % self.burst_every_s < self.burst_len_s
+        ):
+            rate *= max(1.0, self.burst_factor)
+        return max(0.0, rate)
+
+
+class LoadGen:
+    """Drive every tenant's arrival process for ``duration_s`` seconds."""
+
+    def __init__(
+        self,
+        submit: SubmitFn,
+        tenants: list[TenantSpec],
+        *,
+        duration_s: float,
+        rng: Optional[random.Random] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.submit = submit
+        self.tenants = tenants
+        self.duration_s = duration_s
+        self.rng = rng or random.Random(49374)
+        self.clock = clock
+        self.sessions_started: dict[str, int] = {}
+        self.turns_submitted: dict[str, int] = {}
+        self._session_seq = 0
+
+    async def run(self) -> dict:
+        """Run all tenants to completion; returns per-tenant arrival counts
+        (``{"sessions": {...}, "turns": {...}}``)."""
+        tasks = [
+            asyncio.ensure_future(self._drive(spec)) for spec in self.tenants
+        ]
+        session_tasks: set[asyncio.Task] = set()
+        self._session_tasks = session_tasks
+        try:
+            await asyncio.gather(*tasks)
+            # let in-flight multi-turn sessions finish their think cycles
+            while session_tasks:
+                await asyncio.gather(*list(session_tasks),
+                                     return_exceptions=True)
+        finally:
+            for t in [*tasks, *session_tasks]:
+                if not t.done():
+                    t.cancel()
+        return {
+            "sessions": dict(self.sessions_started),
+            "turns": dict(self.turns_submitted),
+        }
+
+    async def _drive(self, spec: TenantSpec) -> None:
+        """One tenant's open-loop arrival process."""
+        start = self.clock()
+        while True:
+            t = self.clock() - start
+            if t >= self.duration_s:
+                return
+            rate = spec.rate_at(t)
+            if rate <= 0:
+                await asyncio.sleep(0.05)
+                continue
+            # exponential inter-arrival → Poisson process at the shaped rate
+            await asyncio.sleep(self.rng.expovariate(rate))
+            if self.clock() - start >= self.duration_s:
+                return
+            self._session_seq += 1
+            sid = f"{spec.name}-s{self._session_seq}"
+            self.sessions_started[spec.name] = (
+                self.sessions_started.get(spec.name, 0) + 1
+            )
+            if spec.session_turns <= 1:
+                await self._turn(spec, sid, 0)
+            else:
+                # sessions run concurrently with the arrival process (open
+                # loop): a slow fleet does NOT slow new session arrivals
+                task = asyncio.ensure_future(self._session(spec, sid))
+                self._session_tasks.add(task)
+                task.add_done_callback(self._session_tasks.discard)
+
+    async def _session(self, spec: TenantSpec, sid: str) -> None:
+        for turn in range(spec.session_turns):
+            if turn:
+                await asyncio.sleep(spec.think_time_s)
+            await self._turn(spec, sid, turn)
+
+    async def _turn(self, spec: TenantSpec, sid: str, turn: int) -> None:
+        self.turns_submitted[spec.name] = (
+            self.turns_submitted.get(spec.name, 0) + 1
+        )
+        await self.submit(spec, sid, turn)

@@ -482,6 +482,32 @@ class Metrics:
             "cordum_jobs_completed_by_class_total",
             "Terminal jobs by SLO job class (JobRequest.priority) and status",
         )
+        # overload resilience (docs/ADMISSION.md): gateway load shedding,
+        # per-(op, class) admission headroom, the brownout ladder tier, and
+        # scheduler-side batch preemption under interactive SLO pressure
+        self.gateway_shed = Counter(
+            "cordum_gateway_shed_total",
+            "Submissions rejected 429 by the gateway, by reason "
+            "(rate_limit | tenant_quota | capacity | capacity_interactive | "
+            "queue_depth | brownout_*) and job class",
+        )
+        self.admission_headroom = Gauge(
+            "cordum_admission_headroom",
+            "Measured capacity minus EWMA offered rate per (op, job_class) "
+            "— negative means the class is being shed analytically",
+        )
+        self.admission_tier = Gauge(
+            "cordum_admission_brownout_tier",
+            "Admission brownout ladder tier (0 = normal, 1 = shed batch, "
+            "2 = also shed best-effort ops, 3 = bounded-queue interactive)",
+        )
+        self.preemptions = Counter(
+            "cordum_preemptions_total",
+            "Batch-job preemptions under interactive SLO pressure, by stage "
+            "(requested = governor asked a worker; requeued = the worker "
+            "handed the job back; redispatched = the job was re-dispatched "
+            "attempts-exempt after the hold-off)",
+        )
         self.slo_burn_rate = Gauge(
             "cordum_slo_burn_rate",
             "SLO error-budget burn rate per objective and window "
@@ -556,6 +582,10 @@ class Metrics:
             self.telemetry_snapshots,
             self.telemetry_dropped,
             self.jobs_by_class,
+            self.gateway_shed,
+            self.admission_headroom,
+            self.admission_tier,
+            self.preemptions,
             self.slo_burn_rate,
             self.eventloop_lag,
             self.slow_ticks,

@@ -1105,7 +1105,11 @@ class StateBusBus(Bus):
             attempt = 1
             while True:
                 try:
-                    await handler(subject, BusPacket.from_wire(packet_bytes))
+                    pkt = BusPacket.from_wire(packet_bytes)
+                    # delivery-local: handlers back off exponentially on it
+                    # (tenant-concurrency NAKs) instead of a fixed cadence
+                    pkt.redelivery_count = attempt - 1
+                    await handler(subject, pkt)
                     return
                 except RetryAfter as ra:
                     if not subj.is_durable_subject(subject) or attempt >= MAX_REDELIVERIES:

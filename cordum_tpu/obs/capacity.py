@@ -31,6 +31,7 @@ strategies (ROADMAP item 2, Gavel-style policies) consume.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Optional
 
 from ..utils.ids import now_us
@@ -218,6 +219,100 @@ class CapacityProfiler:
         """Every profile row (exported form) — local introspection/tests."""
         with self._lock:
             return [self._export_row(r) for r in self._rows.values()]
+
+
+# ---------------------------------------------------------------------------
+# CapacityView — the scheduler-side fold of worker capacity beacons
+# ---------------------------------------------------------------------------
+
+
+class CapacityView:
+    """Per-worker per-op steady-state throughput, folded from the workers'
+    telemetry beacons — the :class:`ThroughputAwareStrategy`'s read-side
+    (ROADMAP item 1; docs/ADMISSION.md §Routing).
+
+    The gateway's :class:`~cordum_tpu.obs.fleet.FleetAggregator` already
+    folds these blocks into ``/api/v1/capacity``; the scheduler folds its
+    own much smaller view (worker beacons only, rates only) from the same
+    ``sys.telemetry.worker`` subject so routing needs no gateway RPC.
+    Worker telemetry ``instance`` ids equal heartbeat ``worker_id``s
+    (cmd/worker wires the exporter that way), so rows join the registry
+    directly.  A restart (``started_at_us`` change) clears the dead
+    epoch's rows; a worker silent past ``stale_after_s`` reads as
+    unmeasured, which drops it back to LeastLoaded routing.
+    """
+
+    def __init__(self, *, stale_after_s: float = 15.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.stale_after_s = stale_after_s
+        self.clock = clock
+        # worker_id → {"rows": {op: {"items_per_s", "tokens_per_s"}},
+        #              "started_at_us": int, "last": monotonic}
+        self._workers: dict[str, dict] = {}
+        self._sub = None
+
+    async def start(self, bus: Any) -> None:
+        from ..protocol import subjects as subj
+
+        self._sub = await bus.subscribe(subj.TELEMETRY_WILDCARD, self._on_snapshot)
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.unsubscribe()
+            self._sub = None
+
+    async def _on_snapshot(self, subject: str, pkt: Any) -> None:
+        snap = pkt.telemetry
+        if snap is not None:
+            self.ingest(snap)
+
+    def ingest(self, snap: Any) -> None:
+        """Fold one telemetry snapshot (also the test entry point)."""
+        if snap.service != "worker" or not snap.instance:
+            return
+        block = (snap.health or {}).get("capacity")
+        if not isinstance(block, dict):
+            return
+        w = self._workers.get(snap.instance)
+        if w is None or (
+            snap.started_at_us and w["started_at_us"] != snap.started_at_us
+        ):
+            # new worker or restart: the dead epoch's cumulative rates are
+            # a different machine-state — start a fresh fold
+            w = self._workers[snap.instance] = {
+                "rows": {}, "started_at_us": snap.started_at_us, "last": 0.0,
+            }
+        w["last"] = self.clock()
+        for key, row in (block.get("rows") or {}).items():
+            if not isinstance(row, dict):
+                continue
+            op = str(row.get("op", "")) or str(key).split("|", 1)[0]
+            # rows are per-(op, bucket); routing wants per-op, so keep the
+            # per-bucket rates and recompute the op aggregate on read
+            w["rows"].setdefault(op, {})[str(row.get("bucket", "-"))] = (
+                float(row.get("items_per_s", 0.0)),
+                float(row.get("tokens_per_s", 0.0)),
+            )
+
+    def rate(self, worker_id: str, op: str) -> float:
+        """Fresh measured steady-state items/s this worker delivers for
+        ``op`` (summed over buckets); 0.0 = unmeasured or stale."""
+        w = self._workers.get(worker_id)
+        if w is None or self.clock() - w["last"] > self.stale_after_s:
+            return 0.0
+        buckets = w["rows"].get(op)
+        if not buckets:
+            return 0.0
+        return sum(items for items, _ in buckets.values())
+
+    def measured_workers(self, op: str) -> dict[str, float]:
+        """worker_id → fresh items/s for every worker measured on ``op``."""
+        out = {}
+        for wid in self._workers:
+            r = self.rate(wid, op)
+            if r > 0:
+                out[wid] = r
+        return out
 
 
 # ---------------------------------------------------------------------------

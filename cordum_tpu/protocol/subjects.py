@@ -12,6 +12,16 @@ WORKFLOW_EVENT = "sys.workflow.event"
 # fan-out — every worker hears it and the addressed one drains.  Not
 # durable: a drain request is an operator action, re-issued if lost.
 DRAIN = "sys.worker.drain"
+# batch-job preemption (docs/ADMISSION.md §Preemption): fan-out — every
+# worker hears the JobPreempt and the one holding the job hands it back
+# (SESSION_REQUEUE) where safe.  Not durable: the preemption governor
+# re-issues while interactive pressure persists, so a lost request only
+# delays one preemption by an evaluation interval.
+PREEMPT = "sys.job.preempt"
+# overload-pressure beacons from the gateway admission controller
+# (docs/ADMISSION.md): the scheduler's preemption governor and the serving
+# engines consume them.  Not durable: pressure is a live signal.
+ADMISSION_PRESSURE = "sys.admission.pressure"
 JOB_EVENTS_WILDCARD = "sys.job.>"  # every job lifecycle event (gateway tap)
 TRACE_SPAN = "sys.trace.span"  # finished flight-recorder spans → collector
 

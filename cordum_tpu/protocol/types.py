@@ -359,6 +359,39 @@ class WorkerDrain(WireModel):
 
 
 @dataclass
+class JobPreempt(WireModel):
+    """Preemption request for one in-flight BATCH job (``sys.job.preempt``
+    fan-out; docs/ADMISSION.md §Preemption).  The worker holding the job
+    hands it back where that is cheap and safe — a serving session requeues
+    mid-decode (its streamed tokens ride the failover resume prefix), a job
+    still waiting for an intake slot gives the slot up — and ignores the
+    request where it is not (a handler already executing on the device).
+    The scheduler re-dispatches preempted jobs attempts-exempt after a
+    short jittered hold-off, so preemption can never FAIL or CANCEL work."""
+
+    job_id: str = ""
+    reason: str = ""
+    requested_by: str = ""
+
+
+@dataclass
+class AdmissionPressure(WireModel):
+    """Overload-pressure beacon from the gateway admission controller
+    (``sys.admission.pressure`` fan-out; docs/ADMISSION.md).  Published when
+    the brownout tier changes and periodically while shedding is active;
+    the scheduler's preemption governor acts on ``preempt_batch`` and the
+    serving engines read it as the batch-deprioritization hint.  Not
+    durable: pressure is a live signal, stale the moment the next
+    evaluation lands."""
+
+    tier: int = 0  # brownout tier (0 = normal .. 3 = bounded interactive)
+    interactive_burn_5m: float = 0.0  # worst interactive 5m burn rate
+    preempt_batch: bool = False  # interactive burn >= warn: requeue batch
+    reason: str = ""
+    sender: str = ""
+
+
+@dataclass
 class SystemAlert(WireModel):
     severity: str = "info"
     source: str = ""
@@ -491,7 +524,9 @@ _PAYLOAD_TYPES: dict[str, type] = {
     "heartbeat": Heartbeat,
     "job_progress": JobProgress,
     "job_cancel": JobCancel,
+    "job_preempt": JobPreempt,
     "worker_drain": WorkerDrain,
+    "admission_pressure": AdmissionPressure,
     "system_alert": SystemAlert,
     "span": Span,
     "telemetry": TelemetrySnapshot,
@@ -525,7 +560,7 @@ class BusPacket(WireModel):
     __slots__ = (
         "trace_id", "sender_id", "created_at_us", "protocol_version",
         "kind", "span_id", "parent_span_id", "_payload", "_raw_payload",
-        "_wire",
+        "_wire", "redelivery_count",
     )
 
     def __init__(
@@ -550,6 +585,12 @@ class BusPacket(WireModel):
         self._payload = payload
         self._raw_payload: Any = None
         self._wire: Optional[bytes] = None
+        # delivery-local, never serialized: how many times the bus has
+        # redelivered THIS delivery after RetryAfter NAKs (0 on the first
+        # attempt).  Handlers use it to back off exponentially instead of
+        # NAKing at a fixed cadence (a tenant burst would otherwise
+        # resonate as a synchronized retry storm).
+        self.redelivery_count = 0
 
     def __repr__(self) -> str:  # debugging/log parity with the old dataclass
         return (
@@ -680,8 +721,16 @@ class BusPacket(WireModel):
         return self.payload if self.kind == "job_cancel" else None
 
     @property
+    def job_preempt(self) -> Optional[JobPreempt]:
+        return self.payload if self.kind == "job_preempt" else None
+
+    @property
     def worker_drain(self) -> Optional[WorkerDrain]:
         return self.payload if self.kind == "worker_drain" else None
+
+    @property
+    def admission_pressure(self) -> Optional[AdmissionPressure]:
+        return self.payload if self.kind == "admission_pressure" else None
 
     @property
     def system_alert(self) -> Optional[SystemAlert]:
@@ -730,6 +779,12 @@ BATCHABLE_OPS = frozenset({"embed", "infer"})
 # route same-key jobs to the same worker (batch affinity) without reading
 # the payload behind the context pointer.
 LABEL_BATCH_KEY = "cordum.batch_key"
+
+# Op-routing label: the gateway stamps the payload's ``op`` at submit so
+# capacity-aware consumers (the ThroughputAwareStrategy's matrix lookup,
+# the admission controller's per-op headroom) can key into the fleet
+# throughput matrix without reading the payload behind the context pointer.
+LABEL_OP = "cordum.op"
 
 # Shard-routing label: the scheduler shard stamps its partition index on the
 # dispatched request so the worker can publish the result straight to the

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import random
 import time
 from typing import Any, AsyncIterator, Optional
 
@@ -39,7 +40,13 @@ class Client:
         role: str = "",
         tenant_id: str = "",
         timeout_s: float = 30.0,
+        retry_429: int = 3,
     ):
+        """``retry_429`` bounds how many times a 429 (rate-limited or
+        admission-shed — docs/ADMISSION.md) is retried.  The client honors
+        the server's ``Retry-After`` with ±25% jitter instead of retrying
+        immediately, so a shed burst de-synchronizes; 0 disables retries."""
+        self._retry_429 = max(0, retry_429)
         headers = {}
         if api_key:
             headers["X-Api-Key"] = api_key
@@ -61,14 +68,31 @@ class Client:
         await self.close()
 
     async def _req(self, method: str, path: str, **kw) -> Any:
-        r = await self._c.request(method, path, **kw)
+        attempt = 0
+        while True:
+            r = await self._c.request(method, path, **kw)
+            if r.status_code == 429 and attempt < self._retry_429:
+                # honor the gateway's honest, headroom-derived Retry-After
+                # with jitter — immediate retries would re-offer the very
+                # load that got shed (docs/ADMISSION.md)
+                attempt += 1
+                await asyncio.sleep(self._retry_delay(r))
+                continue
+            try:
+                body = r.json()
+            except ValueError:
+                body = {"raw": r.text}
+            if r.status_code >= 400:
+                raise ApiError(r.status_code, str(body.get("error", body)))
+            return body
+
+    @staticmethod
+    def _retry_delay(r: httpx.Response) -> float:
         try:
-            body = r.json()
+            delay = float(r.headers.get("Retry-After", ""))
         except ValueError:
-            body = {"raw": r.text}
-        if r.status_code >= 400:
-            raise ApiError(r.status_code, str(body.get("error", body)))
-        return body
+            delay = 0.5
+        return min(30.0, max(0.05, delay)) * (1.0 + random.uniform(-0.25, 0.25))
 
     # -- jobs -----------------------------------------------------------
     async def submit_job(

@@ -48,6 +48,9 @@ DEFAULT_MAX_NEW_TOKENS = 64
 # prefill chunks co-scheduled into one mixed step: more rows admit faster,
 # but each chunk spends flat-buffer slots the decode rows also want
 DEFAULT_MAX_CONCURRENT_PREFILLS = 2
+# SLO classes whose prefill chunks take the step budget first
+# (docs/ADMISSION.md §Serving)
+INTERACTIVE_CLASSES = frozenset({"INTERACTIVE", "CRITICAL"})
 
 
 class SessionCancelled(Exception):
@@ -78,6 +81,10 @@ class GenRequest:
     session_key: str = ""
     eos_token: Optional[int] = None
     stream: bool = True
+    # SLO class (JobRequest.priority, stamped by the worker intake): batch
+    # prefill chunks yield step-budget headroom to interactive ones
+    # (docs/ADMISSION.md §Serving)
+    job_class: str = "BATCH"
     # failover resume (LABEL_RESUME_TOKENS): tokens a previous worker
     # already generated and streamed for this job.  They prefill as a
     # forced-decode prefix (prompt + resume ride the chunked prefill path),
@@ -447,12 +454,21 @@ class ServingEngine:
             ))
             rows.append((sess, 1, True))
             budget -= 1
-        for sess in self._active.values():
-            if (
-                sess.prefilled or sess.frozen or budget <= 0
-                or chunks >= self.max_concurrent_prefills
-            ):
-                continue
+        # prefill candidates ride interactive-first (stable within a class,
+        # so admission order still breaks ties): under load the leftover
+        # token budget goes to interactive prompts and BATCH prefill waits —
+        # batch decode rows above keep their single-token slots, only new
+        # batch prompt ingestion is deprioritized (docs/ADMISSION.md)
+        prefilling = [
+            s for s in self._active.values()
+            if not s.prefilled and not s.frozen
+        ]
+        prefilling.sort(
+            key=lambda s: 0 if s.req.job_class in INTERACTIVE_CLASSES else 1
+        )
+        for sess in prefilling:
+            if budget <= 0 or chunks >= self.max_concurrent_prefills:
+                break
             # the prefill sequence is prompt + any forced-decode resume
             # prefix (minus its last token, which decodes as a normal row);
             # the completing chunk samples only for resume-free sessions

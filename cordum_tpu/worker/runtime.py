@@ -176,6 +176,11 @@ class Worker:
         self._migration: Optional[MigrationServer] = None
         self._peers: dict[str, dict] = {}
         self._session_partition: dict[str, str] = {}
+        # batch preemption (docs/ADMISSION.md §Preemption): jobs still
+        # waiting for an intake semaphore slot can be asked to give it back
+        # — the waiter future wins the race against the acquire and the job
+        # returns to the scheduler as a non-terminal SESSION_REQUEUE
+        self._preempt_waiters: dict[str, asyncio.Future] = {}
         self._draining = False
         self._drained = asyncio.Event()
         self._drain_task: Optional[asyncio.Task] = None
@@ -244,6 +249,7 @@ class Worker:
             self._topic_subs.append(await self.bus.subscribe(topic, self._on_job, queue=self.pool))
         self._subs.append(await self.bus.subscribe(subj.CANCEL, self._on_cancel))
         self._subs.append(await self.bus.subscribe(subj.DRAIN, self._on_drain))
+        self._subs.append(await self.bus.subscribe(subj.PREEMPT, self._on_preempt))
         if self._serving is not None:
             # live-migration listener + the peer map drain targets come
             # from (fan-out heartbeats carry each peer's listener address
@@ -297,6 +303,26 @@ class Worker:
             # the admission queue) and free its KV pages; its waiter raises
             # SessionCancelled → ordinary CANCELLED result
             self._serving.cancel(c.job_id)
+
+    async def _on_preempt(self, subject: str, pkt: BusPacket) -> None:
+        """Batch-job preemption (docs/ADMISSION.md §Preemption): hand the
+        job back to the scheduler where that is cheap and safe — a serving
+        session requeues mid-decode (its pages free immediately and its
+        streamed tokens ride the failover resume prefix), a job still
+        waiting for an intake slot gives the slot up.  A handler already
+        executing on the device is NOT interrupted: the request is simply
+        ignored and the governor moves on."""
+        p = pkt.job_preempt
+        if p is None or not p.job_id:
+            return
+        waiter = self._preempt_waiters.get(p.job_id)
+        if waiter is not None and not waiter.done():
+            waiter.set_result(p.reason or "preempted")
+            return
+        if self._serving is not None and p.job_id in self._active:
+            # requeue only if it really is a live session here (requeue()
+            # returns False for unknown ids, so this is belt-and-braces)
+            self._serving.requeue(p.job_id, "preempted")
 
     # ------------------------------------------------------------------
     # graceful drain + session migration (docs/SERVING.md §Migration,
@@ -536,6 +562,10 @@ class Worker:
             if batch_parts is None and self._serving is not None:
                 gen_req = self._serving.parts(payload)
                 if gen_req is not None:
+                    # the SLO class rides into the decode loop: batch
+                    # prefill chunks yield step-budget headroom to
+                    # interactive ones (docs/ADMISSION.md §Serving)
+                    gen_req.job_class = req.priority or "BATCH"
                     rt = (req.labels or {}).get(LABEL_RESUME_TOKENS, "")
                     if rt:
                         # failover re-dispatch: the scheduler stamped the
@@ -558,10 +588,34 @@ class Worker:
                 payload=payload, batch_parts=batch_parts, gen_req=gen_req,
             )
             return
-        async with self._sem:
-            await self._run_job(
-                req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id, payload=payload
+        # per-job path: the semaphore acquire races a preemption waiter so a
+        # BATCH job still queued for a slot can give it back under
+        # interactive pressure (docs/ADMISSION.md §Preemption).  Once the
+        # slot is held, the job is no longer preemptible.
+        waiter: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._preempt_waiters[req.job_id] = waiter
+        acquire = asyncio.ensure_future(self._sem.acquire())
+        try:
+            await asyncio.wait(
+                {acquire, waiter}, return_when=asyncio.FIRST_COMPLETED
             )
+        finally:
+            self._preempt_waiters.pop(req.job_id, None)
+        if acquire.done() and not acquire.cancelled():
+            waiter.cancel()
+            try:
+                await self._run_job(
+                    req, trace_id=pkt.trace_id, parent_span_id=pkt.span_id,
+                    payload=payload,
+                )
+            finally:
+                self._sem.release()
+            return
+        acquire.cancel()
+        await self._publish_requeue(
+            req.job_id, "preempted: yielded intake slot", trace_id=pkt.trace_id,
+            partition=(req.labels or {}).get(LABEL_PARTITION, ""),
+        )
 
     async def _run_job(
         self,

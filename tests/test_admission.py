@@ -1,0 +1,829 @@
+"""Overload resilience (ISSUE 13): capacity-aware admission control,
+priority load shedding, throughput-aware routing, and batch preemption.
+
+Covers the AdmissionController's analytic/fallback/brownout decision paths
+(incl. the cold/stale-matrix fallback and the never-divide-by-zero
+guarantee), the ThroughputAwareStrategy's skewed-matrix routing and its
+LeastLoaded degradation, the tenant-NAK exponential backoff, both gateway
+429 paths' Retry-After headers + shed metrics, the SDK's jittered
+Retry-After honor, the preemption loop end-to-end (pressure beacon →
+governor → worker requeue → attempts-exempt re-dispatch → completion),
+serving batch-prefill deprioritization, and the loadgen's traffic shaping.
+"""
+import asyncio
+import json
+
+import pytest
+
+from cordum_tpu.controlplane.gateway.admission import (
+    AdmissionController,
+    render_admission_table,
+)
+from cordum_tpu.infra.bus import LoopbackBus, MAX_NAK_DELAY_S, RetryAfter
+from cordum_tpu.infra.metrics import Metrics
+from cordum_tpu.obs.fleet import FleetAggregator
+from cordum_tpu.protocol import subjects as subj
+from cordum_tpu.protocol.types import (
+    AdmissionPressure,
+    BusPacket,
+    Heartbeat,
+    JobRequest,
+    LABEL_OP,
+    LABEL_SESSION_KEY,
+    TelemetrySnapshot,
+)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def worker_beacon(instance: str, rows: dict, *, started: int = 1,
+                  seq: int = 0) -> TelemetrySnapshot:
+    """A worker telemetry snapshot carrying a capacity block (the shape
+    Worker.telemetry_health → CapacityProfiler.snapshot produces)."""
+    return TelemetrySnapshot(
+        service="worker", instance=instance, seq=seq, started_at_us=started,
+        interval_s=2.0,
+        health={"role": "worker", "capacity": {
+            "v": 1, "seq": seq, "full": True, "device_kind": "cpu",
+            "rows": rows,
+        }},
+    )
+
+
+def cap_row(op: str, items_per_s: float, *, bucket: str = "-",
+            tokens_per_s: float = 0.0) -> dict:
+    return {"op": op, "bucket": bucket, "n": 100, "items": 100,
+            "items_per_s": items_per_s, "tokens_per_s": tokens_per_s}
+
+
+class FakeSLO:
+    """SLOTracker stand-in returning scripted burn states."""
+
+    def __init__(self, burn_5m: float = 0.0, state: str = "ok"):
+        self.burn_5m = burn_5m
+        self.state = state
+
+    def evaluate(self, aggregator) -> list[dict]:
+        return [{
+            "name": "interactive", "job_class": "INTERACTIVE",
+            "state": self.state,
+            "windows": {"5m": {"burn_rate": self.burn_5m},
+                        "1h": {"burn_rate": self.burn_5m}},
+        }]
+
+
+def make_controller(*, config=None, slo=None, fleet=None, bus=None,
+                    rng=None, metrics=None):
+    clock_box = [0.0]
+    ctrl = AdmissionController(
+        fleet=fleet if fleet is not None else FleetAggregator(None),
+        slo_tracker=slo, config=config if config is not None else {"enabled": True},
+        metrics=metrics or Metrics(), bus=bus,
+        clock=lambda: clock_box[0],
+        rng=rng or (lambda: 0.0),  # 0.0 → shed whenever there is ANY excess
+    )
+    return ctrl, clock_box
+
+
+def offer(ctrl, clock_box, op, klass, n, *, dt=1.0, tenant=""):
+    """Record n arrivals then roll the EWMA over dt seconds: the offered
+    rate for (op, klass) becomes exactly n/dt on the first roll."""
+    for _ in range(n):
+        ctrl._arrivals[(op, klass)] = ctrl._arrivals.get((op, klass), 0) + 1
+    clock_box[0] += dt
+    ctrl.refresh(clock_box[0])
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController — analytic headroom
+# ---------------------------------------------------------------------------
+
+
+async def test_disabled_controller_admits_everything():
+    ctrl, _ = make_controller(config={})
+    assert not ctrl.enabled
+    v = ctrl.admit(op="chat", job_class="BATCH", tenant="t")
+    assert v.allowed and v.mode == "disabled"
+
+
+async def test_analytic_batch_shed_first_interactive_protected():
+    """Warm matrix: BATCH sheds as soon as total offered exceeds the
+    capacity budget; INTERACTIVE rides until its OWN share is exhausted."""
+    fleet = FleetAggregator(None)
+    fleet.ingest(worker_beacon("w1", {"chat|-": cap_row("chat", 100.0)}))
+    ctrl, clock = make_controller(fleet=fleet)
+    # offered: 30/s interactive + 150/s batch = 180/s vs 90/s budget (0.9)
+    offer(ctrl, clock, "chat", "INTERACTIVE", 30)
+    offer(ctrl, clock, "chat", "BATCH", 150)
+    vb = ctrl.admit(op="chat", job_class="BATCH", now=clock[0])
+    assert not vb.allowed and vb.reason == "capacity"
+    assert vb.retry_after_s >= ctrl.min_retry_after_s
+    vi = ctrl.admit(op="chat", job_class="INTERACTIVE", now=clock[0])
+    assert vi.allowed and vi.mode == "analytic"
+
+
+async def test_interactive_sheds_past_its_own_capacity_share():
+    fleet = FleetAggregator(None)
+    fleet.ingest(worker_beacon("w1", {"chat|-": cap_row("chat", 100.0)}))
+    ctrl, clock = make_controller(fleet=fleet)
+    offer(ctrl, clock, "chat", "INTERACTIVE", 200)  # 200/s vs 90/s budget
+    v = ctrl.admit(op="chat", job_class="INTERACTIVE", now=clock[0])
+    assert not v.allowed and v.reason == "capacity_interactive"
+
+
+async def test_proportional_shed_fraction():
+    """rng near 1.0 admits even under excess (shed probability < 1), so
+    shedding is proportional, not shed-everything."""
+    fleet = FleetAggregator(None)
+    fleet.ingest(worker_beacon("w1", {"chat|-": cap_row("chat", 100.0)}))
+    # excess/batch_offered = (120-90)/120 = 0.25 → rng 0.9 admits
+    ctrl, clock = make_controller(fleet=fleet, rng=lambda: 0.9)
+    offer(ctrl, clock, "chat", "BATCH", 120)
+    assert ctrl.admit(op="chat", job_class="BATCH", now=clock[0]).allowed
+    # rng 0.1 < 0.25 sheds
+    ctrl2, clock2 = make_controller(fleet=fleet, rng=lambda: 0.1)
+    offer(ctrl2, clock2, "chat", "BATCH", 120)
+    assert not ctrl2.admit(op="chat", job_class="BATCH", now=clock2[0]).allowed
+
+
+async def test_retry_after_is_headroom_derived_and_bounded():
+    fleet = FleetAggregator(None)
+    fleet.ingest(worker_beacon("w1", {"chat|-": cap_row("chat", 100.0)}))
+    ctrl, clock = make_controller(fleet=fleet)
+    offer(ctrl, clock, "chat", "BATCH", 900)  # 10× the 90/s budget
+    v = ctrl.admit(op="chat", job_class="BATCH", now=clock[0])
+    assert not v.allowed
+    # (offered − cap)/cap = (900−90)/90 = 9.0 s, clamped to max (15 s default)
+    assert ctrl.min_retry_after_s <= v.retry_after_s <= ctrl.max_retry_after_s
+    assert v.retry_after_s >= 5.0  # genuinely derived, not the floor
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController — cold/stale matrix fallback (satellite)
+# ---------------------------------------------------------------------------
+
+
+async def test_cold_matrix_falls_back_to_queue_depth():
+    """No capacity rows at all: the controller must not divide by zero and
+    must use the scheduler-backlog heuristic — batch shed past the limit,
+    interactive only past the (much larger) interactive bound."""
+    fleet = FleetAggregator(None)
+    # scheduler beacon carrying a deep backlog, but NO worker capacity rows
+    fleet.ingest(TelemetrySnapshot(
+        service="scheduler", instance="s0", started_at_us=1, interval_s=2.0,
+        health={"role": "scheduler", "queue_depth": 500},
+    ))
+    ctrl, clock = make_controller(
+        fleet=fleet,
+        config={"enabled": True, "queue_depth_limit": 100,
+                "interactive_queue_bound": 1000},
+    )
+    offer(ctrl, clock, "chat", "BATCH", 50)
+    vb = ctrl.admit(op="chat", job_class="BATCH", now=clock[0])
+    assert not vb.allowed and vb.reason == "queue_depth" and vb.mode == "fallback"
+    vi = ctrl.admit(op="chat", job_class="INTERACTIVE", now=clock[0])
+    assert vi.allowed and vi.mode == "fallback"
+
+
+async def test_empty_fleet_no_zero_division():
+    ctrl, clock = make_controller(fleet=FleetAggregator(None))
+    for _ in range(50):
+        v = ctrl.admit(op="anything", job_class="BATCH", now=clock[0])
+    assert v.allowed and v.mode == "fallback"  # empty backlog → admit
+
+
+async def test_stale_rows_excluded_then_reengage_analytic():
+    """Rows from a worker whose beacon went stale leave the per-op totals
+    (capacity_doc marks them stale); fresh rows re-engage analytic mode."""
+    fleet = FleetAggregator(None, instance_evict_s=10_000.0)
+    fleet.ingest(worker_beacon("w1", {"chat|-": cap_row("chat", 100.0)}))
+    inst = fleet._instances[("worker", "w1")]
+    inst.last_seen -= 1000.0  # beacon long overdue → stale
+    ctrl, clock = make_controller(fleet=fleet)
+    offer(ctrl, clock, "chat", "BATCH", 500)
+    v = ctrl.admit(op="chat", job_class="BATCH", now=clock[0])
+    assert v.mode == "fallback"  # stale row ⇒ no analytic capacity
+    # fresh beacon lands → the next refresh goes analytic again
+    fleet.ingest(worker_beacon("w1", {"chat|-": cap_row("chat", 100.0)}, seq=1))
+    clock[0] += 1.0
+    ctrl.refresh(clock[0])
+    v2 = ctrl.admit(op="chat", job_class="BATCH", now=clock[0])
+    assert not v2.allowed and v2.mode == "analytic"
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController — brownout ladder + tenant quotas + pressure
+# ---------------------------------------------------------------------------
+
+
+async def test_brownout_tier1_sheds_all_batch():
+    fleet = FleetAggregator(None)
+    fleet.ingest(worker_beacon("w1", {"chat|-": cap_row("chat", 1000.0)}))
+    ctrl, clock = make_controller(fleet=fleet, slo=FakeSLO(burn_5m=2.0))
+    offer(ctrl, clock, "chat", "BATCH", 1)  # far under capacity
+    assert ctrl.tier == 1
+    v = ctrl.admit(op="chat", job_class="BATCH", now=clock[0])
+    assert not v.allowed and v.reason == "brownout_batch"
+    # interactive still rides
+    assert ctrl.admit(op="chat", job_class="INTERACTIVE", now=clock[0]).allowed
+
+
+async def test_brownout_tier2_sheds_best_effort_ops():
+    fleet = FleetAggregator(None)
+    fleet.ingest(worker_beacon("w1", {"embed|-": cap_row("embed", 1000.0)}))
+    ctrl, clock = make_controller(
+        fleet=fleet, slo=FakeSLO(burn_5m=20.0, state="page"),
+        config={"enabled": True, "best_effort_ops": ["embed"]},
+    )
+    clock[0] += 1.0
+    ctrl.refresh(clock[0])
+    assert ctrl.tier == 2
+    v = ctrl.admit(op="embed", job_class="INTERACTIVE", now=clock[0])
+    assert not v.allowed and v.reason == "brownout_best_effort"
+
+
+async def test_brownout_tier3_bounds_interactive():
+    fleet = FleetAggregator(None)
+    fleet.ingest(TelemetrySnapshot(
+        service="scheduler", instance="s0", started_at_us=1, interval_s=2.0,
+        health={"role": "scheduler", "queue_depth": 5000},
+    ))
+    ctrl, clock = make_controller(
+        fleet=fleet, slo=FakeSLO(burn_5m=20.0, state="page"),
+        config={"enabled": True, "queue_depth_limit": 10,
+                "interactive_queue_bound": 100},
+    )
+    clock[0] += 1.0
+    ctrl.refresh(clock[0])
+    assert ctrl.tier == 3
+    v = ctrl.admit(op="chat", job_class="INTERACTIVE", now=clock[0])
+    assert not v.allowed and v.reason == "brownout_interactive"
+
+
+async def test_tenant_token_bucket_quota():
+    ctrl, clock = make_controller(config={
+        "enabled": True,
+        "tenants": {"acme": {"rate_rps": 1.0, "burst": 2}},
+    })
+    now = clock[0]
+    assert ctrl.admit(op="x", job_class="BATCH", tenant="acme", now=now).allowed
+    assert ctrl.admit(op="x", job_class="BATCH", tenant="acme", now=now).allowed
+    v = ctrl.admit(op="x", job_class="BATCH", tenant="acme", now=now)
+    assert not v.allowed and v.reason == "tenant_quota"
+    assert v.retry_after_s > 0
+    # unknown tenants fall to "default"; absent default = unlimited
+    assert ctrl.admit(op="x", job_class="BATCH", tenant="other", now=now).allowed
+    # a token accrues after 1/rate seconds
+    clock[0] += 1.1
+    assert ctrl.admit(op="x", job_class="BATCH", tenant="acme",
+                      now=clock[0]).allowed
+
+
+async def test_pressure_beacon_published_on_tier_change():
+    bus = LoopbackBus(sync=True)
+    got: list[AdmissionPressure] = []
+
+    async def tap(subject, pkt):
+        got.append(pkt.admission_pressure)
+
+    await bus.subscribe(subj.ADMISSION_PRESSURE, tap)
+    slo = FakeSLO(burn_5m=2.0)
+    ctrl, clock = make_controller(bus=bus, slo=slo)
+    clock[0] += 1.0
+    ctrl.refresh(clock[0])
+    assert await ctrl.publish_pressure(clock[0])
+    assert got and got[-1].preempt_batch and got[-1].tier == 1
+    # unchanged tier inside the beacon interval: no re-publish
+    assert not await ctrl.publish_pressure(clock[0] + 0.1)
+    # recovery publishes the all-clear once
+    slo.burn_5m = 0.0
+    clock[0] += 1.0
+    ctrl.refresh(clock[0])
+    assert await ctrl.publish_pressure(clock[0])
+    assert not got[-1].preempt_batch and got[-1].tier == 0
+
+
+async def test_admission_doc_and_render():
+    fleet = FleetAggregator(None)
+    fleet.ingest(worker_beacon("w1", {"chat|-": cap_row("chat", 100.0)}))
+    ctrl, clock = make_controller(
+        fleet=fleet,
+        config={"enabled": True, "tenants": {"acme": {"rate_rps": 5, "burst": 5}}},
+    )
+    offer(ctrl, clock, "chat", "INTERACTIVE", 20)
+    ctrl.admit(op="chat", job_class="INTERACTIVE", tenant="acme", now=clock[0])
+    doc = ctrl.doc()
+    assert doc["enabled"] and doc["tier"] == 0
+    assert doc["ops"]["chat"]["capacity_per_s"] == 90.0
+    assert doc["ops"]["chat"]["offered"]["INTERACTIVE"] == 20.0
+    assert doc["tenants"]["acme"]["tokens"] is not None
+    text = render_admission_table(doc)
+    assert "brownout tier 0" in text and "chat" in text
+    assert json.dumps(doc)  # JSON-serializable for GET /api/v1/admission
+
+
+# ---------------------------------------------------------------------------
+# CapacityView + ThroughputAwareStrategy
+# ---------------------------------------------------------------------------
+
+
+def make_strategy(rates: dict, *, clock=None):
+    from cordum_tpu.controlplane.scheduler.strategy import (
+        ThroughputAwareStrategy,
+    )
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.registry import WorkerRegistry
+    from cordum_tpu.obs.capacity import CapacityView
+
+    reg = WorkerRegistry()
+    pc = parse_pool_config({"topics": {"job.storm": "p"}, "pools": {"p": {}}})
+    view = CapacityView(clock=clock or (lambda: 0.0))
+    for wid, rate in rates.items():
+        reg.update(Heartbeat(worker_id=wid, pool="p", max_parallel_jobs=1 << 30))
+        if rate > 0:
+            view.ingest(worker_beacon(wid, {"chat|-": cap_row("chat", rate)}))
+    strat = ThroughputAwareStrategy(reg, pc, capacity=view, native=False)
+    return strat, view, reg
+
+
+def _route_counts(strat, n=120, labels=None):
+    counts: dict[str, int] = {}
+    for i in range(n):
+        subject = strat.pick_subject(JobRequest(
+            job_id=f"j{i}", topic="job.storm",
+            labels=labels or {LABEL_OP: "chat"},
+        ))
+        counts[subject] = counts.get(subject, 0) + 1
+    return counts
+
+
+async def test_throughput_strategy_skews_to_fast_worker():
+    """ISSUE 13 acceptance: a 3:1 synthetic matrix routes ≥2:1 fast:slow
+    (the smooth WRR gives exactly the weight ratio)."""
+    strat, _, _ = make_strategy({"w-fast": 300.0, "w-slow": 100.0})
+    counts = _route_counts(strat)
+    fast = counts.get("worker.w-fast.jobs", 0)
+    slow = counts.get("worker.w-slow.jobs", 0)
+    assert fast + slow == 120
+    assert slow > 0  # proportional, not winner-take-all starvation
+    assert fast >= 2 * slow
+    assert strat.routed_measured == 120
+
+
+async def test_throughput_strategy_empty_matrix_is_least_loaded():
+    """No measured rows → behavior must equal LeastLoadedStrategy's."""
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.registry import WorkerRegistry
+
+    strat, _, reg = make_strategy({"w-a": 0.0, "w-b": 0.0})
+    pc = parse_pool_config({"topics": {"job.storm": "p"}, "pools": {"p": {}}})
+    baseline = LeastLoadedStrategy(reg, pc, native=False)
+    for i in range(20):
+        req = JobRequest(job_id=f"j{i}", topic="job.storm",
+                         labels={LABEL_OP: "chat"})
+        assert strat.pick_subject(req) == baseline.pick_subject(req)
+    assert strat.routed_fallback == 20 and strat.routed_measured == 0
+
+
+async def test_throughput_strategy_unmeasured_worker_gets_median_weight():
+    strat, _, _ = make_strategy({"w-m": 200.0, "w-new": 0.0})
+    counts = _route_counts(strat, n=60)
+    # the unmeasured worker receives traffic (so it becomes measured) at
+    # roughly the median measured weight — i.e. an even split here
+    assert counts.get("worker.w-new.jobs", 0) >= 20
+
+
+async def test_throughput_strategy_session_affinity_delegates():
+    strat, _, _ = make_strategy({"w-fast": 300.0, "w-slow": 100.0})
+    counts = _route_counts(
+        strat, n=30,
+        labels={LABEL_OP: "chat", LABEL_SESSION_KEY: "conv-1"},
+    )
+    assert len(counts) == 1  # sticky: every turn rides to one worker
+
+
+async def test_capacity_view_staleness_and_restart():
+    clock_box = [0.0]
+    strat, view, _ = make_strategy({"w1": 100.0}, clock=lambda: clock_box[0])
+    assert view.rate("w1", "chat") == 100.0
+    clock_box[0] += 100.0  # beacon silent past stale_after_s
+    assert view.rate("w1", "chat") == 0.0
+    # fresh beacon from a RESTARTED worker (new started_at_us) replaces rows
+    view.ingest(worker_beacon("w1", {"embed|-": cap_row("embed", 50.0)},
+                              started=999))
+    assert view.rate("w1", "chat") == 0.0  # dead epoch's row cleared
+    assert view.rate("w1", "embed") == 50.0
+
+
+# ---------------------------------------------------------------------------
+# tenant-concurrency NAK backoff (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _engine_stack(**kw):
+    from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+    from cordum_tpu.controlplane.scheduler.engine import Engine
+    from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.jobstore import JobStore
+    from cordum_tpu.infra.kv import MemoryKV
+    from cordum_tpu.infra.registry import WorkerRegistry
+
+    kv = MemoryKV()
+    bus = LoopbackBus()
+    js = JobStore(kv)
+    kernel = SafetyKernel(policy_doc={
+        "tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}})
+    reg = WorkerRegistry()
+    pc = parse_pool_config({"topics": {"job.work": "p"}, "pools": {"p": {}}})
+    eng = Engine(bus=bus, job_store=js, safety=SafetyClient(kernel.check),
+                 strategy=LeastLoadedStrategy(reg, pc, native=False),
+                 registry=reg, **kw)
+    return kv, bus, js, reg, eng
+
+
+async def test_tenant_nak_backoff_exponential_with_jitter():
+    kv, bus, js, reg, eng = _engine_stack(tenant_concurrency_limit=1)
+    # one active job pins the tenant at its limit
+    await js.set_state("held", __import__(
+        "cordum_tpu.protocol.types", fromlist=["JobState"]).JobState.PENDING,
+        fields={"tenant_id": "default"})
+    ops = js.tenant_active_add_ops("default", "held")
+    await kv.pipe_execute({}, ops)
+    assert await js.tenant_active_count("default") == 1
+
+    async def delay_for(redeliveries: int) -> float:
+        with pytest.raises(RetryAfter) as exc:
+            await eng.handle_job_request(
+                JobRequest(job_id=f"j-{redeliveries}", topic="job.work",
+                           tenant_id="default"),
+                redeliveries=redeliveries,
+            )
+        return exc.value.delay_s
+
+    d0 = await delay_for(0)
+    d3 = await delay_for(3)
+    d20 = await delay_for(20)
+    assert 0.25 * 0.75 <= d0 <= 0.25 * 1.25
+    assert 2.0 * 0.75 <= d3 <= 2.0 * 1.25  # 0.25 × 2³, ±25%
+    assert d20 <= MAX_NAK_DELAY_S * 1.25  # capped
+    assert d3 > d0  # genuinely grows per redelivery
+
+
+async def test_bus_stamps_redelivery_count():
+    bus = LoopbackBus()
+    seen: list[int] = []
+
+    async def handler(subject, pkt):
+        seen.append(pkt.redelivery_count)
+        if len(seen) < 3:
+            raise RetryAfter(0.0, "again")
+
+    await bus.subscribe(subj.SUBMIT, handler, queue="q")
+    await bus.publish(subj.SUBMIT, BusPacket.wrap(
+        JobRequest(job_id="r1", topic="job.work"), sender_id="t"))
+    await bus.drain()
+    assert seen == [0, 1, 2]
+    await bus.close()
+
+
+# ---------------------------------------------------------------------------
+# preemption end-to-end (acceptance: requeued, not FAILED/CANCELLED,
+# attempts-exempt, completes after the burst)
+# ---------------------------------------------------------------------------
+
+
+async def test_preemption_end_to_end_requeues_and_completes():
+    from cordum_tpu.infra.memstore import MemoryStore
+    from cordum_tpu.worker.runtime import Worker
+
+    kv, bus, js, reg, eng = _engine_stack()
+    await eng.start()
+    worker = Worker(bus=bus, store=MemoryStore(kv), worker_id="w1", pool="p",
+                    topics=["job.work"], max_parallel_jobs=1,
+                    heartbeat_interval_s=999)
+
+    async def slow_handler(ctx):
+        await asyncio.sleep(0.4)
+        return {"ok": True}
+
+    worker.register("job.work", slow_handler)
+    await worker.start()
+    await asyncio.sleep(0.02)
+
+    # saturate: 3 BATCH jobs on a 1-slot worker — one runs, two queued.
+    # NO bus.drain() here: drain would await the slow handlers themselves
+    # and the burst would be over before pressure arrives.
+    for i in range(3):
+        await bus.publish(subj.SUBMIT, BusPacket.wrap(
+            JobRequest(job_id=f"b{i}", topic="job.work", priority="BATCH",
+                       tenant_id="default"),
+            sender_id="t"))
+    for _ in range(100):  # wait until all three are dispatched, not done
+        await asyncio.sleep(0.005)
+        states = [await js.get_state(f"b{i}") for i in range(3)]
+        if all(s in ("DISPATCHED", "RUNNING") for s in states):
+            break
+    assert all(s in ("DISPATCHED", "RUNNING") for s in states), states
+
+    # interactive pressure arrives: the governor preempts dispatched BATCH
+    await bus.publish(subj.ADMISSION_PRESSURE, BusPacket.wrap(
+        AdmissionPressure(tier=1, interactive_burn_5m=3.0,
+                          preempt_batch=True, reason="slo_pressure"),
+        sender_id="gw"))
+    m = eng.metrics
+    deadline = asyncio.get_running_loop().time() + 5.0
+    while asyncio.get_running_loop().time() < deadline:
+        if m.preemptions.value(reason="requeued") > 0:
+            break
+        await asyncio.sleep(0.02)
+    assert m.preemptions.value(reason="requested") > 0
+    assert m.preemptions.value(reason="requeued") > 0
+
+    # preempted jobs complete after the burst (attempts-exempt hold-off ≈1s)
+    deadline = asyncio.get_running_loop().time() + 10.0
+    while asyncio.get_running_loop().time() < deadline:
+        states = [await js.get_state(f"b{i}") for i in range(3)]
+        if all(s == "SUCCEEDED" for s in states):
+            break
+        await bus.drain()
+        await asyncio.sleep(0.05)
+    states = [await js.get_state(f"b{i}") for i in range(3)]
+    assert states == ["SUCCEEDED"] * 3  # requeued, never FAILED/CANCELLED
+    for i in range(3):
+        meta = await js.get_meta(f"b{i}")
+        assert int(meta.get("attempts", "1")) == 1  # attempts-exempt
+
+    await worker.stop()
+    await eng.stop()
+    await bus.close()
+
+
+async def test_preempt_ignored_for_executing_job():
+    """A job already holding its intake slot is NOT interrupted: preemption
+    only reclaims queued slots and serving sessions."""
+    from cordum_tpu.infra.memstore import MemoryStore
+    from cordum_tpu.worker.runtime import Worker
+    from cordum_tpu.protocol.types import JobPreempt
+
+    kv, bus, js, reg, eng = _engine_stack()
+    await eng.start()
+    worker = Worker(bus=bus, store=MemoryStore(kv), worker_id="w1", pool="p",
+                    topics=["job.work"], max_parallel_jobs=1,
+                    heartbeat_interval_s=999)
+    started = asyncio.Event()
+
+    async def handler(ctx):
+        started.set()
+        await asyncio.sleep(0.2)
+        return {"ok": True}
+
+    worker.register("job.work", handler)
+    await worker.start()
+    await bus.publish(subj.SUBMIT, BusPacket.wrap(
+        JobRequest(job_id="run1", topic="job.work", priority="BATCH",
+                   tenant_id="default"), sender_id="t"))
+    await bus.drain()
+    await asyncio.wait_for(started.wait(), 5.0)
+    await bus.publish(subj.PREEMPT, BusPacket.wrap(
+        JobPreempt(job_id="run1", reason="slo_pressure"), sender_id="s"))
+    deadline = asyncio.get_running_loop().time() + 5.0
+    while asyncio.get_running_loop().time() < deadline:
+        if await js.get_state("run1") == "SUCCEEDED":
+            break
+        await bus.drain()
+        await asyncio.sleep(0.02)
+    assert await js.get_state("run1") == "SUCCEEDED"
+    await worker.stop()
+    await eng.stop()
+    await bus.close()
+
+
+# ---------------------------------------------------------------------------
+# serving: batch prefill deprioritization
+# ---------------------------------------------------------------------------
+
+
+async def test_serving_interactive_prefill_rides_before_batch():
+    from cordum_tpu.serving.engine import GenRequest, ServingEngine, _Session
+
+    class StubBackend:
+        num_pages = 64
+        page_size = 16
+        max_context = 512
+        max_seqs = 8
+        max_batch_tokens = 8  # tight budget: one prefill chunk per step
+
+    async def run_blocking(fn, *a):
+        return fn(*a)
+
+    eng = ServingEngine(StubBackend(), run_blocking=run_blocking,
+                        max_concurrent_prefills=1)
+    loop = asyncio.get_running_loop()
+    # batch session admitted FIRST; both need prefill
+    s_batch = _Session(job_id="b", req=GenRequest(
+        prompt=list(range(20)), job_class="BATCH"), future=loop.create_future())
+    s_int = _Session(job_id="i", req=GenRequest(
+        prompt=list(range(20)), job_class="INTERACTIVE"),
+        future=loop.create_future())
+    eng._active = {"b": s_batch, "i": s_int}
+    entries, rows = eng._assemble()
+    # the single prefill chunk in the budget belongs to the INTERACTIVE one
+    assert len(entries) == 1 and entries[0].key == "i"
+    assert entries[0].phase == "prefill"
+    # admission order still breaks ties within one class
+    s_int2 = _Session(job_id="i2", req=GenRequest(
+        prompt=list(range(20)), job_class="INTERACTIVE"),
+        future=loop.create_future())
+    eng._active = {"b": s_batch, "i": s_int, "i2": s_int2}
+    entries, _ = eng._assemble()
+    assert entries[0].key == "i"
+    for f in (s_batch.future, s_int.future, s_int2.future):
+        f.cancel()
+
+
+# ---------------------------------------------------------------------------
+# gateway 429 paths + SDK Retry-After honor (satellites)
+# ---------------------------------------------------------------------------
+
+
+class AdmStack:
+    """Minimal gateway behind a live HTTP server with admission wired."""
+
+    def __init__(self, *, admission_config=None, rate_rps=0.0):
+        from aiohttp.test_utils import TestServer
+        from cordum_tpu.controlplane.gateway.app import Gateway
+        from cordum_tpu.controlplane.gateway.auth import BasicAuthProvider
+        from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+        from cordum_tpu.infra.configsvc import ConfigService
+        from cordum_tpu.infra.jobstore import JobStore
+        from cordum_tpu.infra.kv import MemoryKV
+        from cordum_tpu.infra.memstore import MemoryStore
+        from cordum_tpu.infra.schemareg import SchemaRegistry
+        from cordum_tpu.workflow.engine import Engine as WorkflowEngine
+        from cordum_tpu.workflow.store import WorkflowStore
+
+        self.kv = MemoryKV()
+        self.bus = LoopbackBus()
+        self.job_store = JobStore(self.kv)
+        mem = MemoryStore(self.kv)
+        schemas = SchemaRegistry(self.kv)
+        configsvc = ConfigService(self.kv)
+        kernel = SafetyKernel(policy_doc={
+            "tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}},
+            configsvc=configsvc)
+        wf_store = WorkflowStore(self.kv)
+        self.gw = Gateway(
+            kv=self.kv, bus=self.bus, job_store=self.job_store, mem=mem,
+            kernel=kernel, wf_store=wf_store,
+            wf_engine=WorkflowEngine(store=wf_store, bus=self.bus, mem=mem,
+                                     schemas=schemas, configsvc=configsvc),
+            schemas=schemas, configsvc=configsvc,
+            auth=BasicAuthProvider(["user-key"]),
+            admission_config=admission_config, rate_rps=rate_rps,
+            telemetry=False,
+        )
+        self.server = TestServer(self.gw.app)
+
+    async def __aenter__(self):
+        await self.server.start_server()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.server.close()
+        await self.bus.close()
+
+    def url(self) -> str:
+        return str(self.server.make_url(""))
+
+
+async def test_gateway_shed_429_retry_after_and_metric():
+    import aiohttp
+
+    async with AdmStack(admission_config={
+        "enabled": True,
+        "tenants": {"default": {"rate_rps": 0.5, "burst": 1}},
+    }) as s:
+        async with aiohttp.ClientSession(
+            headers={"X-Api-Key": "user-key"}
+        ) as http:
+            r1 = await http.post(s.url() + "/api/v1/jobs",
+                                 json={"topic": "job.work", "priority": "BATCH"})
+            assert r1.status == 202
+            r2 = await http.post(s.url() + "/api/v1/jobs",
+                                 json={"topic": "job.work", "priority": "BATCH"})
+            assert r2.status == 429
+            assert float(r2.headers["Retry-After"]) > 0
+            body = await r2.json()
+            assert body["reason"] == "tenant_quota"
+            assert s.gw.metrics.gateway_shed.value(
+                reason="tenant_quota", job_class="BATCH") == 1
+            # live controller state endpoint
+            r3 = await http.get(s.url() + "/api/v1/admission")
+            doc = await r3.json()
+            assert doc["enabled"] and doc["shed"]
+            # bulk path: per-entry verdicts + the header rides the response
+            r4 = await http.post(
+                s.url() + "/api/v1/jobs:batch",
+                json={"jobs": [{"topic": "job.work"}]})
+            assert r4.status == 400 and "Retry-After" in r4.headers
+
+
+async def test_gateway_rate_limit_429_has_retry_after():
+    import aiohttp
+
+    async with AdmStack(rate_rps=0.001) as s:
+        async with aiohttp.ClientSession(
+            headers={"X-Api-Key": "user-key"}
+        ) as http:
+            last = None
+            for _ in range(5):
+                last = await http.get(s.url() + "/api/v1/jobs")
+                if last.status == 429:
+                    break
+            assert last is not None and last.status == 429
+            assert float(last.headers["Retry-After"]) > 0
+            assert s.gw.metrics.gateway_shed.value(
+                reason="rate_limit", job_class="unknown") >= 1
+
+
+async def test_sdk_honors_retry_after_with_backoff():
+    from cordum_tpu.sdk.client import ApiError, Client
+
+    async with AdmStack(admission_config={
+        "enabled": True,
+        "tenants": {"default": {"rate_rps": 4.0, "burst": 1}},
+    }) as s:
+        async with Client(s.url(), api_key="user-key", retry_429=3) as c:
+            t0 = asyncio.get_running_loop().time()
+            await c.submit_job("job.work")  # takes the burst token
+            doc = await c.submit_job("job.work")  # shed once, retried
+            elapsed = asyncio.get_running_loop().time() - t0
+            assert "job_id" in doc
+            # the retry actually slept ≈ Retry-After (1/rate = 0.25 s),
+            # not an immediate hammer
+            assert elapsed >= 0.15
+        async with Client(s.url(), api_key="user-key", retry_429=0) as c0:
+            # retries disabled: the first empty-bucket hit surfaces as 429
+            # (the bucket is drained from the block above, so a burst of
+            # submits must trip it within a few calls)
+            with pytest.raises(ApiError) as exc:
+                for _ in range(5):
+                    await c0.submit_job("job.work")
+            assert exc.value.status == 429
+
+
+async def test_gateway_stamps_op_label():
+    async with AdmStack(admission_config={"enabled": True}) as s:
+        import aiohttp
+
+        async with aiohttp.ClientSession(
+            headers={"X-Api-Key": "user-key"}
+        ) as http:
+            r = await http.post(
+                s.url() + "/api/v1/jobs",
+                json={"topic": "job.work", "payload": {"op": "embed"}})
+            jid = (await r.json())["job_id"]
+        req = await s.job_store.get_request(jid)
+        assert req.labels[LABEL_OP] == "embed"
+
+
+# ---------------------------------------------------------------------------
+# loadgen traffic shaping
+# ---------------------------------------------------------------------------
+
+
+async def test_loadgen_shaping_and_sessions():
+    from cordum_tpu.infra.loadgen import LoadGen, TenantSpec
+
+    spec = TenantSpec(name="t", rate_rps=100.0, burst_factor=3.0,
+                      burst_every_s=10.0, burst_len_s=1.0,
+                      diurnal_period_s=40.0, diurnal_amp=0.5)
+    assert spec.rate_at(0.5) == pytest.approx(
+        100.0 * 3.0 * (1 + 0.5 * __import__("math").sin(
+            2 * __import__("math").pi * 0.5 / 40.0)))
+    assert spec.rate_at(5.0) < spec.rate_at(0.5)  # burst window closed
+
+    turns: list[tuple[str, str, int]] = []
+
+    async def submit(s, sid, turn):
+        turns.append((s.name, sid, turn))
+
+    gen = LoadGen(submit, [
+        TenantSpec(name="chat", rate_rps=60.0, session_turns=3,
+                   think_time_s=0.01),
+        TenantSpec(name="flood", rate_rps=200.0),
+    ], duration_s=0.5)
+    counts = await gen.run()
+    assert counts["sessions"]["flood"] > 20  # open loop actually drove
+    assert counts["turns"]["chat"] == 3 * counts["sessions"]["chat"]
+    chat_sessions = {sid for name, sid, _ in turns if name == "chat"}
+    assert all(
+        sorted(t for n, s, t in turns if s == sid) == [0, 1, 2]
+        for sid in chat_sessions
+    )

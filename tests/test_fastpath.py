@@ -285,6 +285,12 @@ def _floor_mod():
     return check_bench_floor
 
 
+_HEALTHY_STORM = {
+    "storm_interactive_p99_ms": 900.0, "storm_interactive_shed_rate": 0.0,
+    "storm_batch_goodput": 35.0, "storm_control_vs_admitted_p99": 5.0,
+}
+
+
 def test_floor_checker_passes_healthy_doc():
     mod = _floor_mod()
     doc = {"value": 2600.0, "selections_per_sec": 90000.0,
@@ -296,7 +302,8 @@ def test_floor_checker_passes_healthy_doc():
            "inter_token_p99_ms": 4.0, "migration_pause_p50_ms": 10.0,
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
-           "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4}
+           "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
+           **_HEALTHY_STORM}
     floors = json.loads((REPO / "bench_floor.json").read_text())
     assert mod.check(doc, floors) == []
 
@@ -315,7 +322,8 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "inter_token_p99_ms": 4.0, "migration_pause_p50_ms": 10.0,
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
-           "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4}
+           "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
+           **_HEALTHY_STORM}
     violations = mod.check(doc, floors)
     assert violations and "value" in violations[0]
     # ceilings guard the other direction (round-trip budget regression)
@@ -326,6 +334,23 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
     doc["kv_roundtrips_per_job"] = 3.0
     doc["serving_compile_count"] = 6  # the old bucketed backend's count
     assert any("serving_compile_count" in v for v in mod.check(doc, floors))
+    doc["serving_compile_count"] = 1
+    # storm overload gates (ISSUE 13): interactive collapse, interactive
+    # shed creep, shed-everything batch starvation, and a controller that
+    # stopped doing anything (control run no longer degrades) all fail
+    doc["storm_interactive_p99_ms"] = 9000.0
+    assert any("storm_interactive_p99_ms" in v for v in mod.check(doc, floors))
+    doc["storm_interactive_p99_ms"] = 900.0
+    doc["storm_interactive_shed_rate"] = 0.2
+    assert any("storm_interactive_shed_rate" in v for v in mod.check(doc, floors))
+    doc["storm_interactive_shed_rate"] = 0.0
+    doc["storm_batch_goodput"] = 0.0
+    assert any("storm_batch_goodput" in v for v in mod.check(doc, floors))
+    doc["storm_batch_goodput"] = 35.0
+    doc["storm_control_vs_admitted_p99"] = 1.0
+    assert any("storm_control_vs_admitted_p99" in v
+               for v in mod.check(doc, floors))
+    doc["storm_control_vs_admitted_p99"] = 5.0
     # end-to-end: main() exits nonzero on a regressed artifact
     bench_json = tmp_path / "bench.json"
     doc["value"] = 100.0
