@@ -1102,6 +1102,13 @@ def _jax_child(device: str) -> None:
     except Exception as ex:  # noqa: BLE001
         out["serving_error"] = f"{type(ex).__name__}: {ex}"[:300]
 
+    # --- disaggregated prefill/decode serving (ISSUE 14): co-located vs
+    # post-prefill hand-off over a 2-worker heterogeneous fleet ---
+    try:
+        out.update(asyncio.run(_bench_disagg(device)))
+    except Exception as ex:  # noqa: BLE001
+        out["disagg_error"] = f"{type(ex).__name__}: {ex}"[:300]
+
     print(json.dumps(out), flush=True)
 
 
@@ -1287,15 +1294,20 @@ async def _bench_worker_serving(device: str) -> dict:
         dt = time.perf_counter() - t0
         st = worker.serving.stats
         steps = sorted(st.step_seconds)
+        ttfts = sorted(st.ttft_seconds)
         sub.unsubscribe()
         await worker.stop()
         await bus.close()
         return {
             "tokens_per_sec": st.decoded_tokens / dt if dt > 0 else 0.0,
+            # prompt-ingestion rate, reported separately from decode so
+            # disaggregation gains are attributable (ISSUE 14)
+            "prefill_tokens_per_sec": st.prefill_tokens / dt if dt > 0 else 0.0,
             "p50_step_ms": (steps[len(steps) // 2] * 1000.0) if steps else 0.0,
             "p99_step_ms": (
                 steps[min(len(steps) - 1, int(len(steps) * 0.99))] * 1000.0
             ) if steps else 0.0,
+            "p50_ttft_ms": (ttfts[len(ttfts) // 2] * 1000.0) if ttfts else 0.0,
             "mean_occupancy": st.mean_occupancy,
             "steps": st.steps,
             # total XLA programs this pass compiled (warmup included): the
@@ -1308,6 +1320,8 @@ async def _bench_worker_serving(device: str) -> dict:
     cont = await run_pass(True)
     out = {
         "decode_tokens_per_sec": round(cont["tokens_per_sec"], 1),
+        "prefill_tokens_per_sec": round(cont["prefill_tokens_per_sec"], 1),
+        "serving_ttft_p50_ms": round(cont["p50_ttft_ms"], 2),
         "sequential_decode_tokens_per_sec": round(seq["tokens_per_sec"], 1),
         "serving_speedup": round(
             cont["tokens_per_sec"] / seq["tokens_per_sec"], 2
@@ -1410,6 +1424,233 @@ async def _bench_session_migration() -> dict:
     return {
         "migration_pause_p50_ms": round(p50_s * 1000.0, 2),
         "migrations_done": migrations,
+    }
+
+
+async def _bench_disagg(device: str) -> dict:
+    """Disaggregated prefill/decode serving (ISSUE 14): a 2-worker
+    in-process fleet — one prefill-biased (large ``serving_prefill_budget``,
+    4 concurrent prefill chunks), one decode-biased (budget 4) — under
+    mixed long-prompt + streaming load, run twice in the same process:
+
+      * **co-located**: jobs round-robin across both workers, no hand-off
+        (every session prefills AND decodes wherever it lands — long
+        prompt chunks share ragged steps with streaming decode rows);
+      * **disaggregated**: every job routes to the prefill worker (the
+        ServingPlacer policy), which live-migrates each session to the
+        decode worker once its prompt finishes prefilling.
+
+    Same two workers, same workload — the delta is the deployment policy.
+    The measured class is the STREAMING sessions; the long prompts are the
+    non-streaming BATCH disturbance.  The ragged entry point's shapes are
+    static (ISSUE 11), so a mixed worker pays its prefill budget's flat-
+    buffer slots on EVERY decode step forever — the co-location tax is
+    structural — while disaggregation's costs (the hand-off blip, the
+    ingestion burst) are transient.  The headline is therefore the
+    STEADY-STATE stream inter-token p99: gaps from the second half of each
+    stream, after the hand-offs and the long-prompt waves have passed —
+    the co-located fleet is still paying the mixed-program tax there, the
+    decode worker is running the right-sized program.  Also reported:
+    stream TTFT p50, long-job completion p50, the full co/disagg ratios,
+    and the hand-off migration count (floor-gated: a disaggregated pass
+    that never migrates is not disaggregated)."""
+    from cordum_tpu.infra.bus import LoopbackBus
+    from cordum_tpu.infra.kv import MemoryKV
+    from cordum_tpu.infra.memstore import MemoryStore
+    from cordum_tpu.models import llama
+    from cordum_tpu.protocol import subjects as subj
+    from cordum_tpu.protocol.types import (
+        BusPacket, JobRequest, STATUS_HINT_STREAM,
+    )
+    from cordum_tpu.worker.handlers import (
+        TPUCompute, make_serving_engine, make_tpu_handlers,
+    )
+    from cordum_tpu.worker.runtime import Worker
+
+    if device == "cpu":
+        # tiny-plus: big enough that flat-buffer slots dominate step cost
+        # (T=16 ≈ 21ms vs T=28 ≈ 31ms vs T=60 ≈ 71ms per step measured on
+        # the 1-core host — the program-size tax being measured), small
+        # enough that two warmed backends fit a CI runner
+        lcfg = llama.LlamaConfig(vocab_size=256, d_model=128, n_layers=4,
+                                 n_heads=4, n_kv_heads=2, d_ff=256,
+                                 max_seq_len=512)
+        n_long, n_stream = 4, 6
+        long_prompt, long_new = 96, 4
+        stream_prompt, stream_new = 8, 192
+    else:
+        lcfg = llama.LlamaConfig(vocab_size=32000, d_model=1024, n_layers=8,
+                                 n_heads=8, n_kv_heads=4, d_ff=3584,
+                                 max_seq_len=512)
+        n_long, n_stream = 8, 8
+        long_prompt, long_new = 256, 8
+        stream_prompt, stream_new = 8, 192
+    n_jobs = n_long + n_stream
+    page_size = 16
+    pages_per = -(-(long_prompt + max(long_new, stream_new)) // page_size)
+    cache_pages = n_jobs * pages_per + 8  # every session fits either worker
+
+    async def run_pass(disagg: bool) -> dict:
+        bus = LoopbackBus()
+        ms = MemoryStore(MemoryKV())
+        workers = []
+        # co-located = the uniform mixed fleet (default prefill budget on
+        # both workers, no hand-off); disaggregated = the SAME two workers
+        # redeployed as one prefill-biased ingester (budget 48, 4
+        # concurrent chunks — affordable precisely because it stops
+        # decoding) + one decode-biased generator (budget 4), with every
+        # session migrating to the decoder post-prefill
+        specs = (
+            (("w-pre", "prefill", 48, 4), ("w-dec", "decode", 4, 1))
+            if disagg else
+            (("w-pre", "mixed", 16, 2), ("w-dec", "mixed", 16, 2))
+        )
+        for wid, role, budget, prefills in specs:
+            w = Worker(bus=bus, store=ms, worker_id=wid, pool="bench",
+                       heartbeat_interval_s=999, serving_role=role)
+            compute = TPUCompute(tp=1, llama_cfg=lcfg)
+            w.register_default(make_tpu_handlers(compute))
+            w.attach_serving(make_serving_engine(
+                compute, w, cache_pages=cache_pages, page_size=page_size,
+                max_sessions=n_jobs,
+                max_new_tokens=max(long_new, stream_new),
+                max_concurrent_prefills=prefills, prefill_budget=budget))
+            await w.start()
+            workers.append(w)
+        for w in workers:
+            # warm the single ragged program so the timed window measures
+            # the policy, not XLA compilation
+            w.serving.backend.prefill(list(range(2, 10)), [1])
+        for w in workers:
+            # peers learn each other's migration listener + role + headroom
+            await w.send_heartbeat()
+        await asyncio.sleep(0)
+
+        submit_at: dict = {}
+        ttft: dict = {}
+        seen: dict = {}
+        last_arrival: dict = {}
+        gaps: list = []
+        long_done_ms: list = []
+        done = asyncio.Event()
+        finished = set()
+
+        async def tap_progress(subject, pkt):
+            pr = pkt.job_progress
+            if pr is None or pr.status_hint != STATUS_HINT_STREAM:
+                return
+            if pr.job_id not in submit_at or not pr.tokens:
+                return
+            now = time.perf_counter()
+            if pr.offset < seen.get(pr.job_id, 0):
+                return  # handover replay of already-streamed tokens
+            tok_idx = pr.offset + len(pr.tokens)
+            seen[pr.job_id] = tok_idx
+            if pr.job_id not in ttft:
+                ttft[pr.job_id] = now - submit_at[pr.job_id]
+            elif pr.job_id in last_arrival:
+                # (token index, gap): the steady-state p99 keeps only the
+                # second half of each stream — past the hand-off blip and
+                # the long-prompt ingestion window
+                gaps.append((tok_idx, now - last_arrival[pr.job_id]))
+            last_arrival[pr.job_id] = now
+
+        async def tap_result(subject, pkt):
+            res = pkt.job_result
+            if res is not None and res.job_id in submit_at:
+                assert res.status == "SUCCEEDED", (
+                    res.job_id, res.status, res.error_message)
+                if res.job_id.endswith("L"):
+                    long_done_ms.append(
+                        (time.perf_counter() - submit_at[res.job_id]) * 1000.0)
+                finished.add(res.job_id)
+                if len(finished) >= n_jobs:
+                    done.set()
+
+        subs = [await bus.subscribe(subj.PROGRESS, tap_progress),
+                await bus.subscribe(subj.RESULT, tap_result)]
+        tag = "d" if disagg else "c"
+
+        async def submit(i: int, is_long: bool) -> None:
+            jid = f"{tag}{i}{'L' if is_long else 'S'}"
+            plen = long_prompt if is_long else stream_prompt
+            ptr = await ms.put_context(jid, {
+                "op": "llm.generate",
+                "tokens": [(i * 13 + j) % lcfg.vocab_size
+                           for j in range(plen)],
+                "max_new_tokens": long_new if is_long else stream_new,
+                "session_id": f"{tag}conv-{i}",
+                # streams are the measured latency class; the long-prompt
+                # BATCH jobs are the disturbance (no token stream — their
+                # cost is step-budget theft, measured via completion time)
+                "stream": not is_long,
+            })
+            # disaggregated: everything routes to the prefill worker (the
+            # ServingPlacer policy); co-located: round-robin spread over
+            # the uniform fleet
+            target = "w-pre" if disagg else ("w-pre", "w-dec")[i % 2]
+            submit_at[jid] = time.perf_counter()
+            await bus.publish(
+                subj.direct_subject(target),
+                BusPacket.wrap(JobRequest(
+                    job_id=jid, topic="job.tpu.generate", context_ptr=ptr,
+                    priority="BATCH" if is_long else "INTERACTIVE",
+                )),
+            )
+
+        t0 = time.perf_counter()
+        for i in range(n_stream):
+            await submit(i, False)
+        # long-prompt waves land on top of the running streams early: the
+        # disturbance (and the hand-offs it triggers) plays out inside the
+        # streams' first half, leaving the second half steady-state
+        for wave in range(2):
+            await asyncio.sleep(0.15)
+            for k in range(n_long // 2):
+                await submit(n_stream + wave * (n_long // 2) + k, True)
+        await asyncio.wait_for(done.wait(), timeout=JAX_TIMEOUT_S / 2)
+        dt = time.perf_counter() - t0
+        migrations = sum(w.serving.stats.migrated_in for w in workers)
+        decoded = sum(w.serving.stats.decoded_tokens for w in workers)
+        for s in subs:
+            s.unsubscribe()
+        for w in workers:
+            await w.stop()
+        await bus.close()
+        ttfts = sorted(ttft.values())
+        steady = sorted(g for idx, g in gaps if idx > stream_new // 2)
+        longs_sorted = sorted(long_done_ms)
+        return {
+            "ttft_p50_ms": (ttfts[len(ttfts) // 2] * 1000.0) if ttfts else 0.0,
+            "inter_token_p99_ms": (
+                steady[min(len(steady) - 1,
+                           int(len(steady) * 0.99))] * 1000.0
+            ) if steady else 0.0,
+            "long_job_p50_ms": (
+                longs_sorted[len(longs_sorted) // 2] if longs_sorted else 0.0
+            ),
+            "migrations": migrations,
+            "tokens_per_sec": decoded / dt if dt > 0 else 0.0,
+        }
+
+    co = await run_pass(False)
+    dis = await run_pass(True)
+    return {
+        "disagg_ttft_p50_ms": round(dis["ttft_p50_ms"], 2),
+        "colocated_ttft_p50_ms": round(co["ttft_p50_ms"], 2),
+        "disagg_ttft_gain": round(
+            co["ttft_p50_ms"] / dis["ttft_p50_ms"], 2
+        ) if dis["ttft_p50_ms"] > 0 else 0.0,
+        "disagg_inter_token_p99_ms": round(dis["inter_token_p99_ms"], 2),
+        "colocated_inter_token_p99_ms": round(co["inter_token_p99_ms"], 2),
+        "disagg_inter_token_gain": round(
+            co["inter_token_p99_ms"] / dis["inter_token_p99_ms"], 2
+        ) if dis["inter_token_p99_ms"] > 0 else 0.0,
+        "disagg_long_job_p50_ms": round(dis["long_job_p50_ms"], 2),
+        "colocated_long_job_p50_ms": round(co["long_job_p50_ms"], 2),
+        "disagg_migrations_done": dis["migrations"],
+        "disagg_decode_tokens_per_sec": round(dis["tokens_per_sec"], 1),
+        "colocated_decode_tokens_per_sec": round(co["tokens_per_sec"], 1),
     }
 
 
@@ -1727,9 +1968,15 @@ _CHILD_METRIC_KEYS = (
     "model_params_m", "single_job_embeds_per_sec", "batched_embeds_per_sec",
     "batched_speedup", "batch_flushes", "max_batch_rows",
     "decode_tokens_per_sec", "sequential_decode_tokens_per_sec",
+    "prefill_tokens_per_sec", "serving_ttft_p50_ms",
     "serving_speedup", "p50_inter_token_ms", "inter_token_p99_ms",
     "serving_mean_occupancy", "serving_steps", "serving_sessions",
     "serving_compile_count", "migration_pause_p50_ms", "migrations_done",
+    "disagg_ttft_p50_ms", "colocated_ttft_p50_ms", "disagg_ttft_gain",
+    "disagg_inter_token_p99_ms", "colocated_inter_token_p99_ms",
+    "disagg_inter_token_gain", "disagg_long_job_p50_ms",
+    "colocated_long_job_p50_ms", "disagg_migrations_done",
+    "disagg_decode_tokens_per_sec", "colocated_decode_tokens_per_sec",
 )
 
 
@@ -1793,7 +2040,7 @@ def bench_jax(*, smoke: bool = False) -> dict:
                     results[k] = child[k]
                     results["fallback_device"] = child.get("device", "cpu")
             for k in ("embed_error", "model_error", "batched_error",
-                      "serving_error", "child_traceback"):
+                      "serving_error", "disagg_error", "child_traceback"):
                 if k not in results and k in child:
                     results[k] = child[k]
             if "device" not in results and "device" in child:
@@ -1803,7 +2050,8 @@ def bench_jax(*, smoke: bool = False) -> dict:
     for metric, err in (("embeds_per_sec", "embed_error"),
                         ("model_tokens_per_sec", "model_error"),
                         ("batched_embeds_per_sec", "batched_error"),
-                        ("decode_tokens_per_sec", "serving_error")):
+                        ("decode_tokens_per_sec", "serving_error"),
+                        ("disagg_ttft_p50_ms", "disagg_error")):
         if metric in results and err in results and results.get("fallback_device"):
             results[f"tpu_{err}"] = results.pop(err)
     return results
@@ -1850,6 +2098,17 @@ def main() -> None:
         out.update(bench_session_affinity())
         out["value"] = out["decode_tokens_per_sec"]
         out["unit"] = "tokens/s"
+        print(json.dumps(out))
+        return
+    if "--disagg" in sys.argv:
+        # disaggregation-only mode (ISSUE 14): co-located vs disaggregated
+        # prefill/decode over a 2-worker in-process fleet, same run.  One
+        # JSON line, same disagg_* keys as the full bench so
+        # bench_floor.json gates both surfaces.
+        out = {"metric": "disagg_ttft_p50_ms", "unit": "ms"}
+        out.update(asyncio.run(_bench_disagg(
+            "cpu" if os.environ.get("JAX_PLATFORMS", "") == "cpu" else "tpu")))
+        out["value"] = out["disagg_ttft_p50_ms"]
         print(json.dumps(out))
         return
     smoke = "--smoke" in sys.argv
@@ -1951,6 +2210,8 @@ def main() -> None:
         # serving (ISSUE 7): continuous-batching decode through the real
         # worker path, vs sequential per-session decode of the same workload
         "decode_tokens_per_sec": jx.get("decode_tokens_per_sec", 0.0),
+        "prefill_tokens_per_sec": jx.get("prefill_tokens_per_sec", 0.0),
+        "serving_ttft_p50_ms": jx.get("serving_ttft_p50_ms", 0.0),
         "sequential_decode_tokens_per_sec": jx.get(
             "sequential_decode_tokens_per_sec", 0.0),
         "serving_speedup": jx.get("serving_speedup", 0.0),
@@ -1963,6 +2224,25 @@ def main() -> None:
         "migration_pause_p50_ms": jx.get("migration_pause_p50_ms", 0.0),
         "migrations_done": jx.get("migrations_done", 0),
         "serving_error": jx.get("serving_error", ""),
+        # disaggregated prefill/decode serving (ISSUE 14): co-located vs
+        # post-prefill hand-off over a 2-worker heterogeneous fleet, same
+        # run — TTFT p50 and inter-token p99 on both sides + the hand-off
+        # migration count (collapse guards in bench_floor.json)
+        "disagg_ttft_p50_ms": jx.get("disagg_ttft_p50_ms", 0.0),
+        "colocated_ttft_p50_ms": jx.get("colocated_ttft_p50_ms", 0.0),
+        "disagg_ttft_gain": jx.get("disagg_ttft_gain", 0.0),
+        "disagg_inter_token_p99_ms": jx.get("disagg_inter_token_p99_ms", 0.0),
+        "colocated_inter_token_p99_ms": jx.get(
+            "colocated_inter_token_p99_ms", 0.0),
+        "disagg_inter_token_gain": jx.get("disagg_inter_token_gain", 0.0),
+        "disagg_long_job_p50_ms": jx.get("disagg_long_job_p50_ms", 0.0),
+        "colocated_long_job_p50_ms": jx.get("colocated_long_job_p50_ms", 0.0),
+        "disagg_migrations_done": jx.get("disagg_migrations_done", 0),
+        "disagg_decode_tokens_per_sec": jx.get(
+            "disagg_decode_tokens_per_sec", 0.0),
+        "colocated_decode_tokens_per_sec": jx.get(
+            "colocated_decode_tokens_per_sec", 0.0),
+        "disagg_error": jx.get("disagg_error", ""),
         **affinity,
         # overload resilience (ISSUE 13): the multi-tenant storm at ~2×
         # measured capacity — interactive p99 holds, interactive shed ≈ 0,
@@ -1976,11 +2256,13 @@ def main() -> None:
         # per-layer µs/op breakdown: routing / codec / selection / commit
         out["profile"] = prof
     for k in ("fallback_device", "tpu_skipped", "tpu_embed_error",
-              "tpu_model_error", "tpu_batched_error", "tpu_serving_error"):
+              "tpu_model_error", "tpu_batched_error", "tpu_serving_error",
+              "tpu_disagg_error"):
         if k in jx:
             out[k] = jx[k]
     degraded = bool(out["embed_error"] or out["model_error"]
-                    or out["batched_error"] or out["serving_error"])
+                    or out["batched_error"] or out["serving_error"]
+                    or out["disagg_error"])
     out["degraded"] = degraded
     if degraded:
         out["child_traceback"] = jx.get("child_traceback", "")

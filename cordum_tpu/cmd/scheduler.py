@@ -72,15 +72,31 @@ async def main() -> None:
     # exact LeastLoaded behavior while the matrix is cold/stale.
     # SCHEDULER_STRATEGY=least_loaded opts out.
     capacity_view = None
+    rebalancer = None
     if os.environ.get("SCHEDULER_STRATEGY", "throughput") == "least_loaded":
         strategy = LeastLoadedStrategy(registry, pool_cfg, metrics=metrics)
     else:
+        from ..controlplane.scheduler.placer import (
+            DecodeRebalancer,
+            ServingPlacer,
+        )
         from ..obs.capacity import CapacityView
 
         capacity_view = CapacityView()
+        # disaggregated serving placement (docs/SERVING.md §Disaggregation):
+        # new llm.generate sessions route by measured prefill tokens/s
+        # headroom; the decode rebalancer migrates sessions off skewed
+        # workers (SCHEDULER_REBALANCER=0 / rebalancer.enabled opt out)
         strategy = ThroughputAwareStrategy(
-            registry, pool_cfg, capacity=capacity_view, metrics=metrics
+            registry, pool_cfg, capacity=capacity_view,
+            placer=ServingPlacer(capacity_view, metrics=metrics),
+            metrics=metrics,
         )
+        if os.environ.get("SCHEDULER_REBALANCER", "1") != "0":
+            rebalancer = DecodeRebalancer.from_config(
+                bus, capacity_view, registry, pool_cfg.rebalancer,
+                metrics=metrics,
+            )
     if shard_count <= 0:  # flag/env unset: pools.yaml scheduler.shards
         shard_count = pool_cfg.scheduler_shards
 
@@ -160,6 +176,18 @@ async def main() -> None:
 
     if capacity_view is not None:
         await capacity_view.start(bus)
+
+    # session ownership announcements (docs/SERVING.md §Disaggregation):
+    # a migration commit retargets the session's affinity entry so
+    # follow-up turns/cancels route to the new page-holding worker
+    from ..protocol import subjects as subj
+
+    async def _on_session_moved(subject: str, pkt) -> None:
+        mv = pkt.session_moved
+        if mv is not None and mv.session_key:
+            strategy.retarget_session(mv.session_key, mv.to_worker)
+
+    moved_sub = await bus.subscribe(subj.SERVING_MOVED, _on_session_moved)
     await engine.start()
     await reconciler.start()
     await replayer.start()
@@ -168,11 +196,16 @@ async def main() -> None:
     await snapshotter.start()
     await telemetry.start()
     await profiler.start()
+    if rebalancer is not None:
+        await rebalancer.start()
     logx.info("scheduler running", instance=engine.instance_id,
               shard=engine.shard_index, shards=engine.shard_count)
     try:
         await _boot.wait_for_shutdown()
     finally:
+        if rebalancer is not None:
+            await rebalancer.stop()
+        moved_sub.unsubscribe()
         await profiler.stop()
         await telemetry.stop()
         await snapshotter.stop()

@@ -17,6 +17,11 @@ the ragged step's chunked-prefill token budget, overridable via
 WORKER_SERVING_CACHE_PAGES / WORKER_SERVING_PAGE_SIZE /
 WORKER_SERVING_MAX_SESSIONS / WORKER_SERVING_MAX_NEW_TOKENS /
 WORKER_SERVING_PREFILL_BUDGET, and WORKER_SERVING=0 disables the engine.
+Disaggregation (docs/SERVING.md §Disaggregation): WORKER_SERVING_ROLE
+(prefill | decode | mixed, or the pool's ``serving_role``) sets the
+placement role — a "prefill" worker live-migrates each session to the
+best decode peer once its prompt finishes prefilling, or earlier once
+prefill crosses WORKER_SERVING_HANDOFF_TOKENS (``serving_handoff_tokens``).
 
 Graceful drain (docs/SERVING.md §Migration, drain, and failover): SIGTERM
 (unless WORKER_DRAIN_ON_TERM=0) and ``cordumctl drain <worker>`` both put
@@ -70,6 +75,7 @@ async def main() -> None:
     kv, bus, conn = await _boot.connect_statebus(cfg)
     env = os.environ
     pool_name = env.get("WORKER_POOL", "tpu-default")
+    pool = _pool_limits(cfg, pool_name)
     worker = Worker(
         bus=bus,
         store=MemoryStore(kv),
@@ -80,8 +86,11 @@ async def main() -> None:
         max_parallel_jobs=_boot.env_int("WORKER_MAX_PARALLEL", 4),
         heartbeat_interval_s=_boot.env_float("WORKER_HEARTBEAT_INTERVAL", 10.0),
         region=env.get("WORKER_REGION", ""),
+        # prefill/decode disaggregation (docs/SERVING.md §Disaggregation):
+        # "prefill" workers hand sessions to decode peers post-prefill
+        serving_role=env.get("WORKER_SERVING_ROLE", "")
+        or (pool.serving_role if pool else "") or "mixed",
     )
-    pool = _pool_limits(cfg, pool_name)
     # one registry shared by the batcher, the serving engine and the fleet
     # telemetry exporter, so worker-side metrics reach the aggregator
     metrics = Metrics()
@@ -119,6 +128,8 @@ async def main() -> None:
         or (pool.serving_max_new_tokens if pool else 0) or 64,
         serving_prefill_budget=_boot.env_int("WORKER_SERVING_PREFILL_BUDGET", 0)
         or (pool.serving_prefill_budget if pool else 0) or 16,
+        serving_handoff_tokens=_boot.env_int("WORKER_SERVING_HANDOFF_TOKENS", 0)
+        or (pool.serving_handoff_tokens if pool else 0),
     )
     profiler = RuntimeProfiler(metrics, service="worker")
     telemetry = TelemetryExporter(

@@ -45,6 +45,7 @@ from ...protocol.types import (
     LABEL_BATCH_KEY,
     LABEL_OP,
     LABEL_SESSION_KEY,
+    SERVING_OPS,
 )
 
 _CHIPS_RE = re.compile(r"^chips:(\d+)$")
@@ -160,6 +161,7 @@ class LeastLoadedStrategy(Strategy):
         self.session_affinity_misses = 0
         self.session_affinity_new = 0
         self.session_affinity_evicted = 0
+        self.session_affinity_retargeted = 0
         # routing caches (ISSUE 6): topic→pools and the native scan's
         # resolved arguments are identical for every job of one shape, so
         # re-deriving them per pick (regex parses, pool scans, ctypes array
@@ -335,10 +337,23 @@ class LeastLoadedStrategy(Strategy):
             self.session_affinity_misses += 1
         elif outcome == "evicted":
             self.session_affinity_evicted += 1
+        elif outcome == "retargeted":
+            self.session_affinity_retargeted += 1
         else:
             self.session_affinity_new += 1
         if self.metrics is not None:
             self.metrics.session_affinity.inc(outcome=outcome)
+
+    def retarget_session(self, session_key: str, worker_id: str) -> None:
+        """Point a session's affinity at its new owner — a ``SessionMoved``
+        announcement after a hand-off/rebalance/drain migration commits
+        (docs/SERVING.md §Disaggregation).  Follow-up turns and cancels
+        then route to the worker actually holding the KV pages instead of
+        the original placement."""
+        if not session_key or not worker_id:
+            return
+        self._record_affinity(_SESSION_PREFIX + session_key, worker_id)
+        self._count_session_affinity("retargeted")
 
     def pick_subject(self, req: JobRequest) -> str:
         labels = req.labels or {}
@@ -461,13 +476,19 @@ class ThroughputAwareStrategy(LeastLoadedStrategy):
     """
 
     def __init__(self, registry: WorkerRegistry, pool_config: PoolConfig, *,
-                 capacity=None, native: bool = True, metrics=None):
+                 capacity=None, placer=None, native: bool = True,
+                 metrics=None):
         super().__init__(registry, pool_config, native=native, metrics=metrics)
         self.capacity = capacity
+        # role-aware serving placement (docs/SERVING.md §Disaggregation):
+        # new llm.generate sessions route by measured prefill tokens/s
+        # headroom instead of the generic items/s WRR
+        self.placer = placer
         # smooth-WRR state per op: worker → current credit
         self._wrr: dict[str, dict[str, float]] = {}
         self.routed_measured = 0
         self.routed_fallback = 0
+        self.routed_placed = 0
 
     _ROUTING_LABELS = ("preferred_worker_id", "preferred_pool",
                        LABEL_BATCH_KEY, LABEL_SESSION_KEY)
@@ -478,8 +499,73 @@ class ThroughputAwareStrategy(LeastLoadedStrategy):
         # one worker, defeating proportional routing)
         return [self.pick_subject(r) for r in reqs]
 
+    def _eligible_workers(self, req: JobRequest, pools,
+                          job_requires) -> list[Heartbeat]:
+        out: list[Heartbeat] = []
+        for hb in self.registry.snapshot().values():
+            pool = next((p for p in pools if p.name == hb.pool), None)
+            if pool is None:
+                continue
+            if not worker_satisfies(hb, pool, job_requires):
+                continue
+            if is_overloaded(hb):
+                continue
+            out.append(hb)
+        return out
+
+    def _pick_serving(self, req: JobRequest, labels: dict) -> str:
+        """Role-aware placement for a serving job (docs/SERVING.md
+        §Disaggregation).  A follow-up turn rides its session affinity to
+        the page-holding worker (retargeted on migration); a NEW session
+        goes to the placer's best measured prefill-headroom worker.
+        Returns "" when the placer has nothing analytic to say — the
+        caller degrades to the generic measured-items/s routing."""
+        pools = self._pools_for_topic(req.topic)
+        if not pools:
+            return ""
+        job_requires = list(req.metadata.requires) if req.metadata else []
+        session_key = labels.get(LABEL_SESSION_KEY, "")
+        session_akey = ""
+        had_entry = False
+        if session_key:
+            session_akey = _SESSION_PREFIX + session_key
+            had_entry = session_akey in self._affinity
+            sticky = self._affinity_worker(
+                session_akey, pools, job_requires, {},
+                ttl_s=SESSION_AFFINITY_TTL_S,
+            )
+            if sticky:
+                self._count_session_affinity("hit")
+                return direct_subject(sticky)
+        winner = self.placer.pick(
+            self._eligible_workers(req, pools, job_requires)
+        )
+        if not winner:
+            # no counting here: the caller's fallback re-runs the affinity
+            # check and counts the outcome exactly once
+            return ""
+        if session_akey:
+            self._count_session_affinity("miss" if had_entry else "new")
+            self._record_affinity(session_akey, winner)
+        self.routed_placed += 1
+        return direct_subject(winner)
+
     def pick_subject(self, req: JobRequest) -> str:
         labels = req.labels or {}
+        # serving jobs take the role-aware placement path FIRST: session
+        # affinity is honored inside it (sticky turns beat throughput), and
+        # only hint/placement-labeled jobs bypass it entirely
+        if (
+            self.placer is not None
+            and labels.get(LABEL_OP, "") in SERVING_OPS
+            and not labels.get("preferred_worker_id")
+            and not labels.get("preferred_pool")
+            and not labels.get(LABEL_BATCH_KEY)
+            and not any(k.startswith("placement.") for k in labels)
+        ):
+            subject = self._pick_serving(req, labels)
+            if subject:
+                return subject
         if self.capacity is None or any(
             labels.get(k) for k in self._ROUTING_LABELS
         ) or any(k.startswith("placement.") for k in labels):
@@ -491,16 +577,7 @@ class ThroughputAwareStrategy(LeastLoadedStrategy):
         if not pools:
             return req.topic
         job_requires = list(req.metadata.requires) if req.metadata else []
-        candidates: list[Heartbeat] = []
-        for hb in self.registry.snapshot().values():
-            pool = next((p for p in pools if p.name == hb.pool), None)
-            if pool is None:
-                continue
-            if not worker_satisfies(hb, pool, job_requires):
-                continue
-            if is_overloaded(hb):
-                continue
-            candidates.append(hb)
+        candidates = self._eligible_workers(req, pools, job_requires)
         if not candidates:
             return req.topic
         measured = {

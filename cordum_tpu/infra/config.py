@@ -83,6 +83,14 @@ class Pool:
     serving_max_sessions: int = 0  # concurrent decode sessions per worker
     serving_max_new_tokens: int = 0  # per-request generation cap
     serving_prefill_budget: int = 0  # ragged-step chunked-prefill tokens
+    # prefill/decode disaggregation (docs/SERVING.md §Disaggregation):
+    # serving_role biases placement — "prefill" workers ingest prompts and
+    # hand sessions off post-prefill, "decode" workers adopt them, "mixed"
+    # (default) does both and never hands off.  serving_handoff_tokens > 0
+    # fires the hand-off once prefill crosses that many tokens (long
+    # prompts start moving before ingestion finishes); 0 = on completion.
+    serving_role: str = ""  # prefill | decode | mixed ("" = mixed)
+    serving_handoff_tokens: int = 0
 
 
 @dataclass
@@ -104,6 +112,10 @@ class PoolConfig:
     # quotas, headroom shedding, brownout ladder) consumed by the gateway's
     # AdmissionController (docs/ADMISSION.md)
     admission: dict = field(default_factory=dict)
+    # rebalancer: the scheduler-side decode rebalancer's knobs (interval,
+    # skew threshold, cooldown, moves per command) consumed by
+    # DecodeRebalancer (docs/SERVING.md §Disaggregation)
+    rebalancer: dict = field(default_factory=dict)
 
     def pools_for_topic(self, topic: str) -> list[Pool]:
         names = self.topics.get(topic)
@@ -139,6 +151,8 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
             serving_max_sessions=int(p.get("serving_max_sessions") or 0),
             serving_max_new_tokens=int(p.get("serving_max_new_tokens") or 0),
             serving_prefill_budget=int(p.get("serving_prefill_budget") or 0),
+            serving_role=str(p.get("serving_role") or ""),
+            serving_handoff_tokens=int(p.get("serving_handoff_tokens") or 0),
         )
     for topic, pools in (doc.get("topics") or {}).items():
         if isinstance(pools, str):
@@ -148,6 +162,7 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
     cfg.statebus = dict(doc.get("statebus") or {})
     cfg.slo = dict(doc.get("slo") or {})
     cfg.admission = dict(doc.get("admission") or {})
+    cfg.rebalancer = dict(doc.get("rebalancer") or {})
     return cfg
 
 

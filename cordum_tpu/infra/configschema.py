@@ -64,6 +64,13 @@ POOLS_SCHEMA: dict[str, Any] = {
                         "serving_max_sessions": _NONNEG_INT,
                         "serving_max_new_tokens": _NONNEG_INT,
                         "serving_prefill_budget": _NONNEG_INT,
+                        # prefill/decode disaggregation (docs/SERVING.md
+                        # §Disaggregation): placement role + the mid-prefill
+                        # hand-off token threshold (0 = on completion)
+                        "serving_role": {
+                            "enum": ["prefill", "decode", "mixed", ""],
+                        },
+                        "serving_handoff_tokens": _NONNEG_INT,
                     },
                     "additionalProperties": False,
                 }],
@@ -147,6 +154,27 @@ POOLS_SCHEMA: dict[str, Any] = {
                         "additionalProperties": False,
                     },
                 },
+            },
+            "additionalProperties": False,
+        },
+        # scheduler-side decode rebalancer (docs/SERVING.md
+        # §Disaggregation): skew detection against the capacity view's
+        # decode occupancy + KV-page pressure, hysteresis-guarded and
+        # rate-limited so sessions never ping-pong
+        "rebalancer": {
+            "type": "object",
+            "properties": {
+                "enabled": {"type": "boolean"},
+                # evaluation cadence
+                "interval_s": {"type": "number", "exclusiveMinimum": 0},
+                # a worker is hot when occupancy >= skew_ratio x fleet median
+                "skew_ratio": {"type": "number", "minimum": 1},
+                # consecutive hot evaluations required before a move fires
+                "hysteresis_ticks": {"type": "integer", "minimum": 1},
+                # per-worker floor between rebalance commands
+                "cooldown_s": _NONNEG,
+                # sessions moved per command
+                "max_moves": {"type": "integer", "minimum": 1},
             },
             "additionalProperties": False,
         },

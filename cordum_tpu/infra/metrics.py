@@ -456,6 +456,29 @@ class Metrics:
             buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
                      2.5),
         )
+        # prefill/decode disaggregation (docs/SERVING.md §Disaggregation):
+        # why migrations fail, post-prefill hand-off outcomes, and the
+        # decode rebalancer's command/move accounting
+        self.serving_migration_failures = Counter(
+            "cordum_serving_migration_failures_total",
+            "Failed session migrations by reason (refused | timeout | io | "
+            "session_gone | no_session | unknown)",
+        )
+        self.serving_handoffs = Counter(
+            "cordum_serving_handoffs_total",
+            "Post-prefill session hand-offs to a decode worker, by outcome "
+            "(ok = moved on the first target; retried_ok = the jittered "
+            "next-best retry landed it; no_peer = decode continued locally; "
+            "failed = every target refused, decode continued locally)",
+        )
+        self.serving_rebalances = Counter(
+            "cordum_serving_rebalance_total",
+            "Decode-rebalancer activity by stage (commanded = the governor "
+            "asked a hot worker to shed; moved = a session migrated toward "
+            "headroom; failed = the move failed and decode continued on the "
+            "hot worker; no_sessions = nothing movable, e.g. every "
+            "candidate was cooldown-immune)",
+        )
         self.session_failovers = Counter(
             "cordum_sched_session_failovers_total",
             "In-flight jobs re-dispatched to a new worker, by reason "

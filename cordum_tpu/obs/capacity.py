@@ -220,6 +220,19 @@ class CapacityProfiler:
         with self._lock:
             return [self._export_row(r) for r in self._rows.values()]
 
+    def steady_tokens_per_s(self, op: str) -> float:
+        """This worker's own steady-state tokens/s for ``op`` (summed over
+        buckets, compile time excluded) — the heartbeat's
+        ``cordum.decode_tokens_per_s`` self-measurement peers rank hand-off
+        targets by (docs/SERVING.md §Disaggregation)."""
+        with self._lock:
+            s = tokens = 0.0
+            for r in self._rows.values():
+                if r["op"] == op and r["steady_s"] > 0:
+                    s += r["steady_s"]
+                    tokens += r["steady_tokens"]
+            return tokens / s if s > 0 else 0.0
+
 
 # ---------------------------------------------------------------------------
 # CapacityView — the scheduler-side fold of worker capacity beacons
@@ -246,7 +259,9 @@ class CapacityView:
                  clock: Callable[[], float] = time.monotonic) -> None:
         self.stale_after_s = stale_after_s
         self.clock = clock
-        # worker_id → {"rows": {op: {"items_per_s", "tokens_per_s"}},
+        # worker_id → {"rows": {op: {bucket: (items/s, tokens/s)}},
+        #              "kv_pages": dict, "occupancy": dict,
+        #              "serving_role": str, "draining": bool,
         #              "started_at_us": int, "last": monotonic}
         self._workers: dict[str, dict] = {}
         self._sub = None
@@ -281,6 +296,8 @@ class CapacityView:
             # a different machine-state — start a fresh fold
             w = self._workers[snap.instance] = {
                 "rows": {}, "started_at_us": snap.started_at_us, "last": 0.0,
+                "kv_pages": {}, "occupancy": {},
+                "serving_role": "", "draining": False,
             }
         w["last"] = self.clock()
         for key, row in (block.get("rows") or {}).items():
@@ -293,17 +310,76 @@ class CapacityView:
                 float(row.get("items_per_s", 0.0)),
                 float(row.get("tokens_per_s", 0.0)),
             )
+        # decode-side serving state (docs/SERVING.md §Disaggregation): page
+        # headroom, decode occupancy, the worker's serving role and its
+        # drain flag ride every capacity block — the ServingPlacer and the
+        # DecodeRebalancer read them with the same staleness bound as rates
+        for extra in ("kv_pages", "occupancy"):
+            v = block.get(extra)
+            if isinstance(v, dict):
+                w[extra] = dict(v)
+        role = block.get("serving_role")
+        if isinstance(role, str):
+            w["serving_role"] = role
+        w["draining"] = bool(block.get("draining", False))
+
+    def _fresh(self, worker_id: str) -> Optional[dict]:
+        w = self._workers.get(worker_id)
+        if w is None or self.clock() - w["last"] > self.stale_after_s:
+            return None
+        return w
 
     def rate(self, worker_id: str, op: str) -> float:
         """Fresh measured steady-state items/s this worker delivers for
         ``op`` (summed over buckets); 0.0 = unmeasured or stale."""
-        w = self._workers.get(worker_id)
-        if w is None or self.clock() - w["last"] > self.stale_after_s:
+        w = self._fresh(worker_id)
+        if w is None:
             return 0.0
         buckets = w["rows"].get(op)
         if not buckets:
             return 0.0
         return sum(items for items, _ in buckets.values())
+
+    def token_rate(self, worker_id: str, op: str) -> float:
+        """Fresh measured steady-state tokens/s for ``op`` (summed over
+        buckets); 0.0 = unmeasured or stale.  The serving placement signal:
+        ``llm.prefill`` rows measure prompt ingestion, ``llm.generate``
+        rows measure steady decode (docs/SERVING.md §Disaggregation)."""
+        w = self._fresh(worker_id)
+        if w is None:
+            return 0.0
+        buckets = w["rows"].get(op)
+        if not buckets:
+            return 0.0
+        return sum(tokens for _, tokens in buckets.values())
+
+    def kv_pages(self, worker_id: str) -> dict:
+        """Fresh KV-page arena gauges (``pages_total`` / ``pages_free`` /
+        ``pages_in_use``); {} = unmeasured or stale."""
+        w = self._fresh(worker_id)
+        return dict(w["kv_pages"]) if w is not None else {}
+
+    def decode_occupancy(self, worker_id: str) -> dict:
+        """Fresh decode-occupancy gauges (``active_sessions`` /
+        ``decode_mean`` / ``decode_max``); {} = unmeasured or stale."""
+        w = self._fresh(worker_id)
+        return dict(w["occupancy"]) if w is not None else {}
+
+    def serving_role(self, worker_id: str) -> str:
+        """The worker's beaconed serving role; "" = unknown/stale (readers
+        treat it as ``mixed``)."""
+        w = self._fresh(worker_id)
+        return str(w["serving_role"]) if w is not None else ""
+
+    def draining(self, worker_id: str) -> bool:
+        w = self._fresh(worker_id)
+        return bool(w["draining"]) if w is not None else False
+
+    def serving_workers(self) -> list[str]:
+        """Every fresh worker currently reporting serving state (a KV-page
+        arena in its capacity block) — the rebalancer's candidate set."""
+        return [wid for wid in self._workers
+                if (self._fresh(wid) or {}).get("kv_pages")]
 
     def measured_workers(self, op: str) -> dict[str, float]:
         """worker_id → fresh items/s for every worker measured on ``op``."""
@@ -327,10 +403,55 @@ _CAP_COLS = (
     ("fresh", "fresh"),
 )
 
+# per-worker serving-state columns (docs/SERVING.md §Disaggregation): the
+# beacons already carry the KV arena, decode occupancy, role and drain
+# flag — this table surfaces them next to the throughput matrix
+_WORKER_COLS = (
+    ("worker", "worker"), ("role", "role"), ("kv_free", "kv_free"),
+    ("kv_used", "kv_used"), ("sessions", "sessions"), ("occ", "occ"),
+    ("draining", "draining"), ("fresh", "fresh"),
+)
+
+
+def _render_rows(cols: tuple, rows: list[dict]) -> list[str]:
+    widths = {
+        key: max(len(title), *(len(row[key]) for row in rows))
+        for title, key in cols
+    }
+    out = ["  ".join(t.ljust(widths[k]) for t, k in cols)]
+    for row in rows:
+        out.append("  ".join(row[k].ljust(widths[k]) for _, k in cols))
+    return out
+
+
+def render_worker_table(workers: dict) -> list[str]:
+    """Per-worker serving-state lines (KV-page headroom, decode occupancy,
+    role, draining) from a capacity doc's ``workers`` map; [] when no
+    worker reports serving state."""
+    rows = []
+    for wid in sorted(workers):
+        w = workers[wid] or {}
+        kv = w.get("kv_pages") or {}
+        occ = w.get("occupancy") or {}
+        if not kv and not occ and not w.get("serving_role"):
+            continue
+        rows.append({
+            "worker": str(wid),
+            "role": str(w.get("serving_role") or "mixed"),
+            "kv_free": str(kv.get("pages_free", "-")),
+            "kv_used": str(kv.get("pages_in_use", "-")),
+            "sessions": str(occ.get("active_sessions", "-")),
+            "occ": f"{occ.get('decode_mean', 0.0):g}",
+            "draining": "yes" if w.get("draining") else "no",
+            "fresh": "yes" if w.get("fresh", True) else "no",
+        })
+    return _render_rows(_WORKER_COLS, rows) if rows else []
+
 
 def render_capacity_table(doc: dict) -> str:
     """ASCII op × worker throughput table for ``cordumctl capacity`` from a
-    ``GET /api/v1/capacity`` document."""
+    ``GET /api/v1/capacity`` document, with a per-worker serving-state
+    section (KV-page headroom, decode occupancy, role, draining)."""
     matrix = doc.get("matrix") or []
     ops = doc.get("ops") or {}
     head = "cordum capacity — {w} worker(s), {r} profile row(s)".format(
@@ -338,8 +459,10 @@ def render_capacity_table(doc: dict) -> str:
     if ops:
         head += "  |  " + "  ".join(
             f"{op}={v}/s" for op, v in sorted(ops.items()))
+    worker_lines = render_worker_table(doc.get("workers") or {})
     if not matrix:
-        return head + "\n(no capacity profiles reported yet)"
+        return "\n".join(
+            [head, *worker_lines, "(no capacity profiles reported yet)"])
     rows = []
     for r in sorted(matrix, key=lambda r: (r.get("op", ""), r.get("bucket", ""),
                                            r.get("worker", ""))):
@@ -357,12 +480,9 @@ def render_capacity_table(doc: dict) -> str:
             "compile_n": str(r.get("compile_n", 0)),
             "fresh": "no" if r.get("stale") else "yes",
         })
-    widths = {
-        key: max(len(title), *(len(row[key]) for row in rows))
-        for title, key in _CAP_COLS
-    }
-    out = [head,
-           "  ".join(t.ljust(widths[k]) for t, k in _CAP_COLS)]
-    for row in rows:
-        out.append("  ".join(row[k].ljust(widths[k]) for _, k in _CAP_COLS))
+    out = [head]
+    if worker_lines:
+        out.extend(worker_lines)
+        out.append("")
+    out.extend(_render_rows(_CAP_COLS, rows))
     return "\n".join(out)

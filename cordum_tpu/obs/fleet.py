@@ -170,7 +170,8 @@ class _InstanceState:
         plain overwrite (a lost beacon self-heals on the next change)."""
         self.capacity_meta = {
             k: block.get(k)
-            for k in ("device_kind", "ts_us", "seq", "kv_pages", "occupancy")
+            for k in ("device_kind", "ts_us", "seq", "kv_pages", "occupancy",
+                      "serving_role", "draining")
             if block.get(k) is not None
         }
         for key, row in (block.get("rows") or {}).items():
@@ -509,7 +510,7 @@ class FleetAggregator:
                 "age_s": age,
                 "rows": len(inst.capacity_rows),
             }
-            for extra in ("kv_pages", "occupancy"):
+            for extra in ("kv_pages", "occupancy", "serving_role", "draining"):
                 if meta.get(extra) is not None:
                     wdoc[extra] = meta[extra]
             workers[inst.instance] = wdoc

@@ -22,6 +22,17 @@ PREEMPT = "sys.job.preempt"
 # (docs/ADMISSION.md): the scheduler's preemption governor and the serving
 # engines consume them.  Not durable: pressure is a live signal.
 ADMISSION_PRESSURE = "sys.admission.pressure"
+# serving disaggregation (docs/SERVING.md §Disaggregation): ownership
+# announcements after a session migration commits (the adopting worker
+# fans out SessionMoved so scheduler shards retarget session affinity to
+# the new owner), and the decode rebalancer's move requests (the scheduler
+# governor fans out SessionRebalance; the addressed worker migrates its
+# cheapest sessions toward the named headroom target).  Neither is
+# durable: affinity self-heals via eviction + re-election on loss, and the
+# governor re-evaluates skew every interval so a lost rebalance request
+# only delays one move.
+SERVING_MOVED = "sys.serving.moved"
+SERVING_REBALANCE = "sys.serving.rebalance"
 JOB_EVENTS_WILDCARD = "sys.job.>"  # every job lifecycle event (gateway tap)
 TRACE_SPAN = "sys.trace.span"  # finished flight-recorder spans → collector
 

@@ -375,6 +375,43 @@ class JobPreempt(WireModel):
 
 
 @dataclass
+class SessionMoved(WireModel):
+    """Ownership announcement after a serving-session migration commits
+    (``sys.serving.moved`` fan-out; docs/SERVING.md §Disaggregation).
+    Published by the ADOPTING worker — the only process that knows the
+    commit landed — so scheduler shards retarget the session's affinity
+    entry to the new owner and follow-up turns/cancels route correctly.
+    Not durable: a lost announcement degrades to the pre-disaggregation
+    behavior (the stale entry is lazily evicted and the next turn
+    re-elects a worker)."""
+
+    job_id: str = ""
+    session_key: str = ""
+    from_worker: str = ""
+    to_worker: str = ""
+    reason: str = ""  # handoff | rebalance | drain
+
+
+@dataclass
+class SessionRebalance(WireModel):
+    """Decode-rebalance request for one worker (``sys.serving.rebalance``
+    fan-out; docs/SERVING.md §Disaggregation).  The scheduler's governor
+    detects decode-occupancy/page-pressure skew in the capacity view and
+    asks the hot worker to live-migrate up to ``max_sessions`` of its
+    cheapest sessions (fewest live pages, oldest decode position) to the
+    named headroom target.  Rate-limited and hysteresis-guarded on the
+    governor side; migrated-in sessions are cooldown-immune on the worker
+    side, so sessions never ping-pong."""
+
+    worker_id: str = ""  # the overloaded worker being asked to shed
+    target_worker: str = ""
+    target_addr: str = ""  # the target's migration listener host:port
+    max_sessions: int = 1
+    reason: str = ""
+    requested_by: str = ""
+
+
+@dataclass
 class AdmissionPressure(WireModel):
     """Overload-pressure beacon from the gateway admission controller
     (``sys.admission.pressure`` fan-out; docs/ADMISSION.md).  Published when
@@ -527,6 +564,8 @@ _PAYLOAD_TYPES: dict[str, type] = {
     "job_preempt": JobPreempt,
     "worker_drain": WorkerDrain,
     "admission_pressure": AdmissionPressure,
+    "session_moved": SessionMoved,
+    "session_rebalance": SessionRebalance,
     "system_alert": SystemAlert,
     "span": Span,
     "telemetry": TelemetrySnapshot,
@@ -733,6 +772,14 @@ class BusPacket(WireModel):
         return self.payload if self.kind == "admission_pressure" else None
 
     @property
+    def session_moved(self) -> Optional[SessionMoved]:
+        return self.payload if self.kind == "session_moved" else None
+
+    @property
+    def session_rebalance(self) -> Optional[SessionRebalance]:
+        return self.payload if self.kind == "session_rebalance" else None
+
+    @property
     def system_alert(self) -> Optional[SystemAlert]:
         return self.payload if self.kind == "system_alert" else None
 
@@ -846,6 +893,33 @@ ERROR_SESSION_REQUEUE = "SESSION_REQUEUE"
 # (the capacity-matrix KV headroom signal drain uses to pick a target).
 LABEL_MIGRATE_ADDR = "cordum.migrate_addr"
 LABEL_KV_PAGES_FREE = "cordum.kv_pages_free"
+
+# Prefill/decode disaggregation (docs/SERVING.md §Disaggregation): the
+# worker's serving role — ``prefill`` workers ingest prompts fast and hand
+# sessions off post-prefill, ``decode`` workers adopt them for steady
+# token generation, ``mixed`` (the default) does both and never hands off.
+# Rides heartbeats (peer hand-off ranking) AND the beacon capacity block
+# (scheduler-side placement + the capacity doc).
+SERVING_ROLE_PREFILL = "prefill"
+SERVING_ROLE_DECODE = "decode"
+SERVING_ROLE_MIXED = "mixed"
+SERVING_ROLES = frozenset(
+    {SERVING_ROLE_PREFILL, SERVING_ROLE_DECODE, SERVING_ROLE_MIXED}
+)
+LABEL_SERVING_ROLE = "cordum.serving_role"
+# Steady-state decode tokens/s this worker measured for itself (the
+# capacity profiler's llm.generate row) — peers rank hand-off targets by
+# KV-page headroom × this rate without a capacity-matrix RPC.
+LABEL_DECODE_TOKENS_PER_S = "cordum.decode_tokens_per_s"
+
+# The synthetic capacity-matrix op name for the prefill side of a mixed
+# ragged step: the serving engine apportions each step's device time
+# between prompt ingestion (this row) and token generation (the
+# ``llm.generate`` row) by delivered tokens, so prefill tokens/s and
+# decode tokens/s are separately measurable — the ServingPlacer routes new
+# sessions on the prefill rate, the rebalancer and hand-off rank targets
+# on the decode rate.
+OP_SERVING_PREFILL = "llm.prefill"
 
 
 def payload_session_key(payload: Any) -> str:

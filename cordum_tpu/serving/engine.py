@@ -111,6 +111,10 @@ class ServingStats:
     admission_waits: int = 0  # admissions delayed by cache exhaustion
     # per-step wall time (seconds), capped ring for inter-token p50/p99
     step_seconds: deque = field(default_factory=lambda: deque(maxlen=4096))
+    # submit → first sampled token (seconds), capped ring for TTFT p50
+    # (resume/migrated-in sessions excluded: their first token belongs to
+    # a previous worker's clock)
+    ttft_seconds: deque = field(default_factory=lambda: deque(maxlen=4096))
 
     @property
     def mean_occupancy(self) -> float:
@@ -134,6 +138,12 @@ class _Session:
     # frozen = mid-migration: the step loop must not advance this session
     # (decode pauses only for the final freeze-and-delta chunk)
     frozen: bool = False
+    # post-prefill hand-off (docs/SERVING.md §Disaggregation): the
+    # on_prefill_done hook fires at most once per session
+    handoff_signaled: bool = False
+    # governor immunity: a migrated-in session may not be rebalanced again
+    # before this monotonic stamp (the anti-ping-pong cooldown)
+    immune_until: float = 0.0
     enqueued_at: float = field(default_factory=time.monotonic)
 
     @property
@@ -174,9 +184,23 @@ class ServingEngine:
         metrics: Optional[Metrics] = None,
         tracer: Optional[Tracer] = None,
         capacity: Optional[Any] = None,
+        handoff_threshold_tokens: int = 0,
+        migrate_in_cooldown_s: float = 30.0,
     ) -> None:
         self.backend = backend
         self.run_blocking = run_blocking  # worker.run_in_executor
+        # post-prefill hand-off (docs/SERVING.md §Disaggregation): the owner
+        # (the worker) sets this to a callable(job_id); the loop invokes it
+        # once per session when its prompt finishes prefilling — or earlier,
+        # once prefill crosses handoff_threshold_tokens (>0) — so a
+        # prefill-roled worker can ship the session to a decode worker
+        # while the KV pages are hot
+        self.on_prefill_done: Optional[Callable[[str], None]] = None
+        self.handoff_threshold_tokens = max(0, handoff_threshold_tokens)
+        # governor anti-ping-pong: sessions adopted via install_session are
+        # immune to pick_rebalance_sessions for this window (drain ignores
+        # it — a draining worker must move everything)
+        self.migrate_in_cooldown_s = max(0.0, migrate_in_cooldown_s)
         # capacity observatory (obs/capacity.py): each ragged step reports
         # delivered tokens at the static flat-buffer bucket, with warmup
         # compiles flagged so steady-state rows exclude them
@@ -559,6 +583,13 @@ class ServingEngine:
                     sess.last_token = t
                     sess.out_tokens.append(t)
                     generated += 1
+                    if len(sess.out_tokens) == 1:
+                        # first token of a locally born session: TTFT
+                        # (resume prefixes pre-populate out_tokens, so
+                        # migrated/resumed sessions never land here)
+                        self.stats.ttft_seconds.append(
+                            time.monotonic() - sess.enqueued_at
+                        )
                     emits.append(self._emit(sess, [t]))
                 if sess.done or sess.cancelled:
                     retired_this_step += 1
@@ -566,6 +597,25 @@ class ServingEngine:
                         sess,
                         error=SessionCancelled(sess.job_id) if sess.cancelled else None,
                     )
+                elif (
+                    self.on_prefill_done is not None
+                    and not sess.handoff_signaled
+                    and not sess.frozen
+                    and (sess.prefilled or (
+                        self.handoff_threshold_tokens > 0
+                        and sess.prefill_pos >= self.handoff_threshold_tokens
+                    ))
+                ):
+                    # post-prefill hand-off trigger: the prompt finished
+                    # prefilling (or crossed the threshold mid-prefill) and
+                    # the session still has tokens to generate — the hook
+                    # fires once; the owner decides whether/where to migrate
+                    sess.handoff_signaled = True
+                    try:
+                        self.on_prefill_done(sess.job_id)
+                    except Exception as e:  # noqa: BLE001 - policy is best-effort
+                        logx.warn("prefill-done hook failed",
+                                  job_id=sess.job_id, err=str(e))
             self.stats.steps += 1
             self.stats.decoded_tokens += generated
             self.stats.prefill_tokens += prefill_fed
@@ -575,14 +625,35 @@ class ServingEngine:
             if self.capacity is not None:
                 # one mixed step at the backend's static flat-buffer shape;
                 # warmup compiles are flagged so the steady-state tokens/s
-                # rows in the capacity matrix exclude them
-                self.capacity.observe(
-                    "llm.generate", device_s=dt,
-                    bucket=str(self.step_tokens),
-                    items=generated, tokens=generated,
-                    compiled=bool(getattr(self.backend, "last_step_compiled",
-                                          False)),
-                )
+                # rows in the capacity matrix exclude them.  The step's
+                # device time is apportioned by delivered tokens between
+                # prompt ingestion (the OP_SERVING_PREFILL row) and token
+                # generation (the llm.generate row), so prefill tokens/s
+                # and decode tokens/s are separately measurable — the
+                # disaggregation policy's two placement signals
+                # (docs/SERVING.md §Disaggregation)
+                from ..protocol.types import OP_SERVING_PREFILL
+
+                compiled = bool(getattr(self.backend, "last_step_compiled",
+                                        False))
+                total_toks = generated + prefill_fed
+                if prefill_fed:
+                    self.capacity.observe(
+                        OP_SERVING_PREFILL,
+                        device_s=dt * prefill_fed / total_toks,
+                        bucket=str(self.step_tokens),
+                        items=prefill_fed, tokens=prefill_fed,
+                        compiled=compiled,
+                    )
+                if generated or not prefill_fed:
+                    self.capacity.observe(
+                        "llm.generate",
+                        device_s=(dt * generated / total_toks
+                                  if total_toks else dt),
+                        bucket=str(self.step_tokens),
+                        items=generated, tokens=generated,
+                        compiled=compiled,
+                    )
             if emits:
                 await asyncio.gather(*emits)
             # every token of this step is appended AND emitted: a freeze
@@ -612,6 +683,26 @@ class ServingEngine:
         drain migrates them in — decoding sessions carry KV state worth
         moving; pending ones are requeued cheaply."""
         return [*self._active.keys(), *(s.job_id for s in self._pending)]
+
+    def pick_rebalance_sessions(self, n: int = 1) -> list[str]:
+        """Cheapest movable sessions for a governor rebalance
+        (docs/SERVING.md §Disaggregation): active, unfrozen, uncancelled,
+        and past their migrated-in cooldown — a session the governor (or a
+        hand-off) just placed here is immune, so skew oscillation can
+        never ping-pong it.  Cheapest = fewest live pages, then oldest
+        (smallest) position — the least KV state to ship; sessions still
+        prefilling qualify (they are the cheapest of all, and migration
+        resumes prefill on the target).  Drain uses :meth:`session_ids`
+        instead and ignores immunity (a draining worker must move
+        everything)."""
+        now = time.monotonic()
+        cands = [
+            s for s in self._active.values()
+            if not s.frozen and not s.cancelled
+            and not s.done and s.immune_until <= now
+        ]
+        cands.sort(key=lambda s: (len(s.pages), s.pos))
+        return [s.job_id for s in cands[:max(0, n)]]
 
     def describe_session(self, job_id: str) -> Optional[dict[str, Any]]:
         """The session's immutable metadata (the migration hello frame);
@@ -756,6 +847,12 @@ class ServingEngine:
         sess.prefill_pos = int(state.get("prefill_pos", 0) or 0)
         sess.out_tokens = [int(t) for t in state.get("out_tokens") or []]
         sess.last_token = int(state.get("last_token", 0) or 0)
+        # anti-ping-pong cooldown: a just-adopted session may not be picked
+        # for another governor rebalance until the window passes
+        sess.immune_until = time.monotonic() + self.migrate_in_cooldown_s
+        # a migrated-in session never re-fires the source's hand-off hook:
+        # it is already where the policy put it
+        sess.handoff_signaled = True
         # arena-less backends (test fakes) rebuild their per-session decode
         # state from the metadata instead of imported pages
         restore = getattr(self.backend, "restore_session", None)

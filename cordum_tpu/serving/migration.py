@@ -229,6 +229,8 @@ async def migrate_session(
     ``(session, offset)`` handshake)."""
     meta = engine.describe_session(job_id)
     if meta is None:
+        if metrics is not None:
+            metrics.serving_migration_failures.inc(reason="no_session")
         return False
     if meta_extra:
         meta.update(meta_extra)
@@ -236,6 +238,7 @@ async def migrate_session(
     frozen = False
     t_freeze = 0.0
     outcome = "failed"
+    fail_reason = "unknown"
     try:
         for attempt in range(max_attempts):
             reader = writer = None
@@ -252,6 +255,7 @@ async def migrate_session(
                 # decoding, so the bulk ships with zero pause
                 state = engine.export_state(job_id)
                 if state is None:
+                    fail_reason = "session_gone"
                     await _abort(writer, job_id)
                     return False
                 stable_tok = (int(state["pos"]) // ps) * ps
@@ -266,6 +270,7 @@ async def migrate_session(
                     await writer.drain()
                 # freeze-and-delta: decode pauses only from here to `done`
                 if not engine.freeze_session(job_id):
+                    fail_reason = "session_gone"
                     await _abort(writer, job_id)
                     return False
                 frozen = True
@@ -273,6 +278,7 @@ async def migrate_session(
                 await engine.wait_quiesced(job_id)
                 state = engine.export_state(job_id)
                 if state is None:  # cancelled while freezing
+                    fail_reason = "session_gone"
                     await _abort(writer, job_id)
                     return False
                 delta = await engine.export_pages(
@@ -293,6 +299,9 @@ async def migrate_session(
                           pause_ms=round(pause * 1000, 2))
                 return True
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                fail_reason = (
+                    "timeout" if isinstance(e, asyncio.TimeoutError) else "io"
+                )
                 # freeze reached: no resume — unfreeze and let the caller
                 # requeue (the receiver's partial state GCs)
                 if frozen or attempt + 1 >= max_attempts:
@@ -301,6 +310,7 @@ async def migrate_session(
                 logx.warn("migration link lost; resuming", job_id=job_id,
                           err=str(e))
             except MigrationError as e:
+                fail_reason = "refused"
                 logx.warn("migration refused", job_id=job_id, err=str(e))
                 return False
             finally:
@@ -315,6 +325,12 @@ async def migrate_session(
             engine.unfreeze_session(job_id)
         if metrics is not None:
             metrics.serving_migrations.inc(role="out", outcome=outcome)
+            if outcome != "ok":
+                # the {reason} split (refused | timeout | io | session_gone
+                # | unknown) tells an operator WHY hand-offs fail — the
+                # callers (hand-off, rebalance, drain) additionally retry
+                # once against their next-best target before falling back
+                metrics.serving_migration_failures.inc(reason=fail_reason)
 
 
 async def _abort(writer: asyncio.StreamWriter, job_id: str) -> None:

@@ -407,6 +407,7 @@ def make_serving_engine(
     max_new_tokens: int = 64,
     max_concurrent_prefills: int = 2,
     prefill_budget: int = 16,
+    handoff_tokens: int = 0,
     metrics=None,
 ):
     """Build the worker's continuous-batching serving engine over a paged
@@ -440,6 +441,7 @@ def make_serving_engine(
         max_sessions=max_sessions,
         max_new_tokens_cap=max_new_tokens,
         max_concurrent_prefills=max_concurrent_prefills,
+        handoff_threshold_tokens=handoff_tokens,
         metrics=metrics,
         tracer=worker.tracer,
         capacity=worker.capacity,
@@ -459,6 +461,7 @@ def attach_default_tpu_worker(
     serving_max_sessions: int = 8,
     serving_max_new_tokens: int = 64,
     serving_prefill_budget: int = 16,
+    serving_handoff_tokens: int = 0,
     metrics=None,
     **kw,
 ) -> TPUCompute:
@@ -480,6 +483,7 @@ def attach_default_tpu_worker(
             max_sessions=serving_max_sessions,
             max_new_tokens=serving_max_new_tokens,
             prefill_budget=serving_prefill_budget,
+            handoff_tokens=serving_handoff_tokens,
             metrics=metrics,
         ))
     return compute

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import random
 import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
@@ -46,11 +47,17 @@ from ..protocol.types import (
     JobRequest,
     JobResult,
     JobState,
+    LABEL_DECODE_TOKENS_PER_S,
     LABEL_KV_PAGES_FREE,
     LABEL_MIGRATE_ADDR,
     LABEL_PARTITION,
     LABEL_RESUME_TOKENS,
+    LABEL_SERVING_ROLE,
+    SERVING_ROLE_MIXED,
+    SERVING_ROLE_PREFILL,
+    SERVING_ROLES,
     STATUS_HINT_STREAM,
+    SessionMoved,
     Span,
 )
 from ..serving.engine import (
@@ -134,6 +141,7 @@ class Worker:
         max_parallel_jobs: int = 4,
         heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
         region: str = "",
+        serving_role: str = SERVING_ROLE_MIXED,
     ):
         self.bus = bus
         self.store = store
@@ -176,6 +184,16 @@ class Worker:
         self._migration: Optional[MigrationServer] = None
         self._peers: dict[str, dict] = {}
         self._session_partition: dict[str, str] = {}
+        # prefill/decode disaggregation (docs/SERVING.md §Disaggregation):
+        # a "prefill"-roled worker hands sessions to a decode peer once
+        # their prompts finish prefilling (or cross the engine's token
+        # threshold); "decode" workers adopt them; "mixed" does both and
+        # never hands off.  The role rides heartbeats + capacity beacons.
+        self.serving_role = (
+            serving_role if serving_role in SERVING_ROLES
+            else SERVING_ROLE_MIXED
+        )
+        self._handoffs: set[str] = set()  # sessions with a hand-off in flight
         # batch preemption (docs/ADMISSION.md §Preemption): jobs still
         # waiting for an intake semaphore slot can be asked to give it back
         # — the waiter future wins the race against the acquire and the job
@@ -217,6 +235,12 @@ class Worker:
         Jobs whose payload it recognizes (``serving.parts``) become decode
         sessions; everything else keeps the per-job handler path."""
         self._serving = serving
+        if self.serving_role == SERVING_ROLE_PREFILL:
+            # post-prefill hand-off (docs/SERVING.md §Disaggregation): the
+            # engine fires once per session when its prompt finishes
+            # prefilling (or crosses serving_handoff_tokens); we pick the
+            # decode peer with the most KV headroom × steady decode rate
+            serving.on_prefill_done = self._on_prefill_done
         # capacity beacon gauges: KV-page/arena headroom + decode occupancy
         # (read at snapshot time, never on the decode hot path)
         alloc = serving.allocator
@@ -260,6 +284,10 @@ class Worker:
             await self._migration.start()
             self._subs.append(
                 await self.bus.subscribe(subj.HEARTBEAT, self._on_peer_heartbeat)
+            )
+            self._subs.append(
+                await self.bus.subscribe(subj.SERVING_REBALANCE,
+                                         self._on_rebalance)
             )
         self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
         await self.send_heartbeat()
@@ -344,32 +372,171 @@ class Worker:
         addr = (hb.labels or {}).get(LABEL_MIGRATE_ADDR, "")
         if not addr:
             return
+        labels = hb.labels or {}
         try:
-            pages_free = int((hb.labels or {}).get(LABEL_KV_PAGES_FREE, "0") or 0)
+            pages_free = int(labels.get(LABEL_KV_PAGES_FREE, "0") or 0)
         except ValueError:
             pages_free = 0
+        try:
+            decode_tps = float(labels.get(LABEL_DECODE_TOKENS_PER_S, "0") or 0)
+        except ValueError:
+            decode_tps = 0.0
         if len(self._peers) > 1024:
             self._peers.clear()  # unbounded-fleet guard
         self._peers[hb.worker_id] = {
             "addr": addr,
             "pages_free": pages_free,
+            # hand-off targets rank by headroom × steady decode tokens/s
+            # (the peer's own capacity-profiler measurement)
+            "decode_tps": decode_tps,
+            "role": labels.get(LABEL_SERVING_ROLE, SERVING_ROLE_MIXED),
             "draining": bool(hb.draining),
             "seen": time.monotonic(),
         }
 
-    def _pick_migration_peer(self) -> str:
-        """The live, non-draining peer with the most free KV pages (the
-        capacity-matrix headroom signal carried on heartbeats); "" when no
-        peer can take sessions — drain then falls back to requeueing."""
+    def _live_peers(self, *, exclude: tuple = ()) -> list[tuple[str, dict]]:
         window = max(30.0, 3 * self.heartbeat_interval_s)
         now = time.monotonic()
-        best, best_free = "", -1
-        for wid, p in self._peers.items():
-            if p["draining"] or now - p["seen"] > window:
-                continue
-            if p["pages_free"] > best_free:
-                best, best_free = p["addr"], p["pages_free"]
-        return best
+        return [
+            (wid, p) for wid, p in self._peers.items()
+            if not p["draining"] and now - p["seen"] <= window
+            and wid not in exclude
+        ]
+
+    def _ranked_drain_peers(self) -> list[tuple[str, str]]:
+        """Every live, non-draining peer as ``(worker_id, addr)``, most
+        free KV pages first — drain targets (any role beats a requeue)."""
+        peers = self._live_peers()
+        peers.sort(key=lambda e: e[1]["pages_free"], reverse=True)
+        return [(wid, p["addr"]) for wid, p in peers]
+
+    def _ranked_handoff_peers(
+        self, *, exclude: tuple = ()
+    ) -> list[tuple[str, str]]:
+        """Decode-capable peers ranked by KV-page headroom × steady decode
+        tokens/s (docs/SERVING.md §Disaggregation) — the hand-off and
+        rebalance target order.  Prefill-roled peers are excluded (their
+        step budget is ingestion capacity); an unmeasured decode rate
+        counts as 1.0 so a fresh decode worker still ranks by headroom."""
+        peers = [
+            (wid, p) for wid, p in self._live_peers(exclude=exclude)
+            if p.get("role", SERVING_ROLE_MIXED) != SERVING_ROLE_PREFILL
+            and p["pages_free"] > 0
+        ]
+        peers.sort(
+            key=lambda e: e[1]["pages_free"] * max(e[1]["decode_tps"], 1.0),
+            reverse=True,
+        )
+        return [(wid, p["addr"]) for wid, p in peers]
+
+    async def _migrate_with_retry(
+        self,
+        job_id: str,
+        targets: list[tuple[str, str]],
+        *,
+        reason: str = "handoff",
+    ) -> tuple[bool, bool]:
+        """Drive one session migration with ONE jittered retry against the
+        next-best target (docs/SERVING.md §Disaggregation) — a single
+        handshake failure must not silently abandon the move.  Returns
+        ``(moved, used_retry)``; on False the session keeps decoding
+        locally (the callers decide between local decode and requeue)."""
+        serving = self._serving
+        if serving is None:
+            return False, False
+        for attempt, (peer_id, addr) in enumerate(targets[:2]):
+            if serving.describe_session(job_id) is None:
+                return False, attempt > 0  # finished/cancelled meanwhile
+            if attempt > 0:
+                # jittered back-off before the fallback target: lets a
+                # transiently wedged listener drain, and decorrelates
+                # concurrent hand-offs retrying into the same peer
+                await asyncio.sleep(random.uniform(0.05, 0.25))
+            host, _, port = addr.rpartition(":")
+            try:
+                moved = await migrate_session(
+                    serving, job_id, host, int(port),
+                    meta_extra={
+                        "partition": self._session_partition.get(job_id, ""),
+                        "from_worker": self.worker_id,
+                        "move_reason": reason,
+                    },
+                    metrics=serving.metrics,
+                )
+            except Exception as e:  # noqa: BLE001 - try the next target
+                logx.warn("migration attempt crashed", job_id=job_id,
+                          target=addr, err=str(e))
+                moved = False
+            if moved:
+                return True, attempt > 0
+        return False, len(targets) > 1
+
+    # ------------------------------------------------------------------
+    # post-prefill hand-off + decode rebalancing (docs/SERVING.md
+    # §Disaggregation)
+    # ------------------------------------------------------------------
+    def _on_prefill_done(self, job_id: str) -> None:
+        """Engine hook (fires once per session, from the decode loop): a
+        prefill-roled worker ships the freshly prefilled session to the
+        best decode peer.  Non-blocking — the loop keeps stepping while
+        the live page phase streams."""
+        if self._draining or self._closed_for_handoff(job_id):
+            return
+        self._handoffs.add(job_id)
+        asyncio.ensure_future(self._handoff_session(job_id))
+
+    def _closed_for_handoff(self, job_id: str) -> bool:
+        return self._serving is None or job_id in self._handoffs
+
+    async def _handoff_session(self, job_id: str) -> None:
+        serving = self._serving
+        metrics = serving.metrics if serving is not None else None
+        try:
+            peers = self._ranked_handoff_peers()
+            if not peers:
+                # no decode-capable peer: decode continues locally — the
+                # policy degrades to co-location, never breaks the session
+                if metrics is not None:
+                    metrics.serving_handoffs.inc(outcome="no_peer")
+                return
+            moved, retried = await self._migrate_with_retry(
+                job_id, peers, reason="handoff")
+            if metrics is not None:
+                outcome = (
+                    ("retried_ok" if retried else "ok") if moved else "failed"
+                )
+                metrics.serving_handoffs.inc(outcome=outcome)
+        finally:
+            self._handoffs.discard(job_id)
+
+    async def _on_rebalance(self, subject: str, pkt: BusPacket) -> None:
+        """The decode rebalancer's move request: migrate our cheapest
+        sessions (fewest live pages, oldest decode position; cooldown-
+        immune sessions excluded — no ping-pong) toward the named
+        headroom target, with the next-best peer as the jittered
+        fallback."""
+        rb = pkt.session_rebalance
+        serving = self._serving
+        if (
+            rb is None or rb.worker_id != self.worker_id
+            or serving is None or self._draining
+        ):
+            return
+        metrics = serving.metrics
+        job_ids = serving.pick_rebalance_sessions(max(1, rb.max_sessions))
+        if not job_ids:
+            if metrics is not None:
+                metrics.serving_rebalances.inc(stage="no_sessions")
+            return
+        fallbacks = self._ranked_handoff_peers(
+            exclude=(rb.target_worker, self.worker_id))
+        targets = [(rb.target_worker, rb.target_addr), *fallbacks]
+        for job_id in job_ids:
+            moved, _ = await self._migrate_with_retry(
+                job_id, targets, reason="rebalance")
+            if metrics is not None:
+                metrics.serving_rebalances.inc(
+                    stage="moved" if moved else "failed")
 
     @property
     def draining(self) -> bool:
@@ -402,20 +569,12 @@ class Worker:
         if self._serving is not None:
             for job_id in list(self._serving.session_ids()):
                 moved = False
-                peer = self._pick_migration_peer()
-                if peer and self._serving.describe_session(job_id) is not None:
-                    host, _, port = peer.rpartition(":")
-                    try:
-                        moved = await migrate_session(
-                            self._serving, job_id, host, int(port),
-                            meta_extra={
-                                "partition": self._session_partition.get(job_id, ""),
-                            },
-                            metrics=self._serving.metrics,
-                        )
-                    except Exception as e:  # noqa: BLE001 - fall back to requeue
-                        logx.warn("migration attempt crashed", job_id=job_id,
-                                  err=str(e))
+                # most-KV-headroom peer first, one jittered retry against
+                # the next-best (any role beats a requeue when draining)
+                peers = self._ranked_drain_peers()
+                if peers and self._serving.describe_session(job_id) is not None:
+                    moved, _ = await self._migrate_with_retry(
+                        job_id, peers, reason="drain")
                 if not moved:
                     # pending sessions (no KV state) and unmigratable ones
                     # go back to the scheduler — re-dispatched, not killed
@@ -465,6 +624,20 @@ class Worker:
         )
         self._session_partition[job_id] = str(meta.get("partition", "") or "")
         asyncio.ensure_future(self._finish_adopted(job_id, gen, trace_id, fut))
+        # ownership announcement (docs/SERVING.md §Disaggregation): the
+        # scheduler retargets the session's affinity so follow-up turns and
+        # cancels route here; fire-and-forget — a lost announcement only
+        # degrades to lazy eviction + re-election
+        asyncio.ensure_future(self.bus.publish(
+            subj.SERVING_MOVED,
+            BusPacket.wrap(SessionMoved(
+                job_id=job_id,
+                session_key=gen.session_key,
+                from_worker=str(meta.get("from_worker", "") or ""),
+                to_worker=self.worker_id,
+                reason=str(meta.get("move_reason", "") or ""),
+            ), trace_id=trace_id, sender_id=self.worker_id),
+        ))
 
     async def _finish_adopted(
         self, job_id: str, gen: GenRequest, trace_id: str, fut: asyncio.Future
@@ -891,6 +1064,14 @@ class Worker:
         }
         if self._serving is not None:
             out["serving_sessions"] = self._serving.active_sessions()
+            # disaggregation placement signals (docs/SERVING.md
+            # §Disaggregation): the role and drain flag ride the capacity
+            # block so the scheduler's CapacityView and the fleet capacity
+            # doc read them with the same staleness bound as the rates
+            out["serving_role"] = self.serving_role
+            out["capacity"]["serving_role"] = self.serving_role
+            if self._draining:
+                out["capacity"]["draining"] = True
         if self._draining:
             out["draining"] = True
         return out
@@ -933,9 +1114,15 @@ class Worker:
         labels = dict(self.labels)
         if self._migration is not None and self._serving is not None:
             # peers live-migrate serving sessions here; the free-page count
-            # is the KV-headroom signal drain target selection ranks by
+            # is the KV-headroom signal drain target selection ranks by,
+            # and the role + steady decode rate let prefill workers rank
+            # hand-off targets (docs/SERVING.md §Disaggregation)
             labels[LABEL_MIGRATE_ADDR] = self._migration.addr
             labels[LABEL_KV_PAGES_FREE] = str(self._serving.allocator.free_pages)
+            labels[LABEL_SERVING_ROLE] = self.serving_role
+            labels[LABEL_DECODE_TOKENS_PER_S] = (
+                f"{self.capacity.steady_tokens_per_s('llm.generate'):.1f}"
+            )
         return Heartbeat(
             worker_id=self.worker_id,
             region=self.region,

@@ -575,3 +575,135 @@ async def test_gateway_traces_analysis_endpoint():
         # the literal route must not shadow real trace ids
         r = await s.client.get("/api/v1/traces/tr-a", headers=s.h())
         assert (await r.json())["span_count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# CapacityView decode-side fields (ISSUE 14, docs/SERVING.md §Disaggregation)
+# ---------------------------------------------------------------------------
+
+
+def _decode_beacon(instance, *, started=1, seq=0, rows=None, kv=None,
+                   occ=None, role=None, draining=False):
+    """A worker telemetry snapshot whose capacity block carries the
+    decode-side serving state (the Worker.telemetry_health shape)."""
+    from cordum_tpu.protocol.types import TelemetrySnapshot
+
+    block = {"v": 1, "seq": seq, "full": True, "device_kind": "cpu",
+             "rows": rows or {}}
+    if kv is not None:
+        block["kv_pages"] = kv
+    if occ is not None:
+        block["occupancy"] = occ
+    if role is not None:
+        block["serving_role"] = role
+    if draining:
+        block["draining"] = True
+    return TelemetrySnapshot(service="worker", instance=instance, seq=seq,
+                             started_at_us=started, interval_s=2.0,
+                             health={"role": "worker", "capacity": block})
+
+
+def _mk_view(clock_box):
+    from cordum_tpu.obs.capacity import CapacityView
+
+    return CapacityView(clock=lambda: clock_box[0])
+
+
+def test_capacity_view_folds_decode_side_fields():
+    """Occupancy, kv_pages_free, serving role and the drain flag fold from
+    worker beacons next to the throughput rows (PR 13 only tested the
+    items/s path) — the ServingPlacer/DecodeRebalancer read side."""
+    clock = [0.0]
+    view = _mk_view(clock)
+    view.ingest(_decode_beacon(
+        "w1",
+        rows={"llm.generate|28": {"op": "llm.generate", "bucket": "28",
+                                  "items_per_s": 90.0, "tokens_per_s": 90.0},
+              "llm.prefill|28": {"op": "llm.prefill", "bucket": "28",
+                                 "items_per_s": 400.0,
+                                 "tokens_per_s": 400.0}},
+        kv={"pages_total": 127, "pages_free": 40, "pages_in_use": 87},
+        occ={"active_sessions": 6, "decode_mean": 5.5, "decode_max": 8},
+        role="decode"))
+    assert view.token_rate("w1", "llm.generate") == 90.0
+    assert view.token_rate("w1", "llm.prefill") == 400.0
+    assert view.kv_pages("w1") == {"pages_total": 127, "pages_free": 40,
+                                   "pages_in_use": 87}
+    assert view.decode_occupancy("w1")["active_sessions"] == 6
+    assert view.serving_role("w1") == "decode"
+    assert view.draining("w1") is False
+    assert view.serving_workers() == ["w1"]
+    # a later beacon flips the drain flag
+    view.ingest(_decode_beacon("w1", seq=1, draining=True,
+                               kv={"pages_total": 127, "pages_free": 40}))
+    assert view.draining("w1") is True
+
+
+def test_capacity_view_decode_fields_staleness_expiry():
+    """A silent worker's decode-side state reads as unmeasured past
+    stale_after_s — the rebalancer must never act on a dead beacon."""
+    clock = [0.0]
+    view = _mk_view(clock)
+    view.ingest(_decode_beacon(
+        "w1", kv={"pages_total": 127, "pages_free": 3},
+        occ={"active_sessions": 9}, role="decode"))
+    assert view.kv_pages("w1")["pages_free"] == 3
+    clock[0] += 100.0  # beacon silent past stale_after_s (15s)
+    assert view.kv_pages("w1") == {}
+    assert view.decode_occupancy("w1") == {}
+    assert view.serving_role("w1") == ""
+    assert view.draining("w1") is False
+    assert view.serving_workers() == []
+
+
+def test_capacity_view_decode_fields_restart_epoch_clear():
+    """A restarted worker (new started_at_us) starts a fresh fold: the dead
+    epoch's occupancy/pages must not linger under the new epoch."""
+    clock = [0.0]
+    view = _mk_view(clock)
+    view.ingest(_decode_beacon(
+        "w1", started=1, kv={"pages_total": 127, "pages_free": 2},
+        occ={"active_sessions": 9}, role="prefill"))
+    assert view.decode_occupancy("w1")["active_sessions"] == 9
+    # restart: fresh epoch, no serving state beaconed yet
+    view.ingest(_decode_beacon("w1", started=999, seq=0))
+    assert view.kv_pages("w1") == {}
+    assert view.decode_occupancy("w1") == {}
+    assert view.serving_role("w1") == ""
+    # the fresh epoch's own state folds normally
+    view.ingest(_decode_beacon("w1", started=999, seq=1,
+                               kv={"pages_total": 127, "pages_free": 120},
+                               role="mixed"))
+    assert view.kv_pages("w1")["pages_free"] == 120
+    assert view.serving_role("w1") == "mixed"
+
+
+def test_capacity_table_renders_worker_serving_columns():
+    """`cordumctl capacity` surfaces per-worker kv_pages_free, decode
+    occupancy and the draining flag (the renderer used to drop them)."""
+    doc = {
+        "workers": {
+            "w-dec": {"service": "worker", "fresh": True, "rows": 1,
+                      "serving_role": "decode", "draining": True,
+                      "kv_pages": {"pages_total": 127, "pages_free": 40,
+                                   "pages_in_use": 87},
+                      "occupancy": {"active_sessions": 6,
+                                    "decode_mean": 5.5}},
+            "w-plain": {"service": "worker", "fresh": True, "rows": 1},
+        },
+        "matrix": [{"op": "llm.generate", "bucket": "28", "worker": "w-dec",
+                    "items_per_s": 90.0, "tokens_per_s": 90.0}],
+        "ops": {"llm.generate": 90.0},
+    }
+    table = render_capacity_table(doc)
+    lines = table.splitlines()
+    header = next(line for line in lines if "kv_free" in line)
+    assert "sessions" in header and "draining" in header and "role" in header
+    row = next(line for line in lines if line.startswith("w-dec"))
+    assert "decode" in row and "40" in row and "87" in row
+    assert "6" in row and "yes" in row  # sessions + draining flag
+    # a worker with no serving state stays out of the serving section but
+    # the matrix still renders
+    assert not any(line.startswith("w-plain") and "yes" in line
+                   for line in lines if "kv_free" not in line)
+    assert any("llm.generate" in line for line in lines)

@@ -290,6 +290,13 @@ _HEALTHY_STORM = {
     "storm_batch_goodput": 35.0, "storm_control_vs_admitted_p99": 5.0,
 }
 
+# disaggregated serving keys (ISSUE 14): migrations happened, the
+# steady-state decode-worker stream p99 held, prefill rate attributable
+_HEALTHY_DISAGG = {
+    "prefill_tokens_per_sec": 850.0, "disagg_migrations_done": 9,
+    "disagg_inter_token_p99_ms": 23.0,
+}
+
 
 def test_floor_checker_passes_healthy_doc():
     mod = _floor_mod()
@@ -303,7 +310,7 @@ def test_floor_checker_passes_healthy_doc():
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
-           **_HEALTHY_STORM}
+           **_HEALTHY_STORM, **_HEALTHY_DISAGG}
     floors = json.loads((REPO / "bench_floor.json").read_text())
     assert mod.check(doc, floors) == []
 
@@ -323,7 +330,7 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
-           **_HEALTHY_STORM}
+           **_HEALTHY_STORM, **_HEALTHY_DISAGG}
     violations = mod.check(doc, floors)
     assert violations and "value" in violations[0]
     # ceilings guard the other direction (round-trip budget regression)
@@ -351,6 +358,19 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
     assert any("storm_control_vs_admitted_p99" in v
                for v in mod.check(doc, floors))
     doc["storm_control_vs_admitted_p99"] = 5.0
+    # disaggregation gates (ISSUE 14): a hand-off policy that stopped
+    # migrating, a decode-worker stream-tail collapse, and a vanished
+    # prefill/decode capacity split all fail
+    doc["disagg_migrations_done"] = 0
+    assert any("disagg_migrations_done" in v for v in mod.check(doc, floors))
+    doc["disagg_migrations_done"] = 9
+    doc["disagg_inter_token_p99_ms"] = 900.0
+    assert any("disagg_inter_token_p99_ms" in v
+               for v in mod.check(doc, floors))
+    doc["disagg_inter_token_p99_ms"] = 23.0
+    doc["prefill_tokens_per_sec"] = 0.0
+    assert any("prefill_tokens_per_sec" in v for v in mod.check(doc, floors))
+    doc["prefill_tokens_per_sec"] = 850.0
     # end-to-end: main() exits nonzero on a regressed artifact
     bench_json = tmp_path / "bench.json"
     doc["value"] = 100.0
