@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import os
 import signal
 import socket
@@ -924,6 +925,257 @@ def bench_selection() -> dict:
         strat.pick_subject(req)
     dt = time.perf_counter() - t0
     return {"selections_per_sec": n / dt, "native": strat._packed is not None}
+
+
+# ---------------------------------------------------------------------------
+# Gang scheduling bench (ISSUE 15, docs/GANG.md) — run via
+# `python bench.py --gang-child [smoke]` in a subprocess that forces an
+# 8-device CPU host platform BEFORE jax initializes (the MULTICHIP mesh).
+# The child drives an in-process fleet through the REAL
+# submit → reserve → rendezvous → step → result pipeline:
+#   * a burst of barrier-only gangs measures the control-plane gang rate
+#     (gang_jobs_per_sec);
+#   * the three MULTICHIP dryrun flows (dense dp×tp×sp, moe dp×tp×ep,
+#     MPMD pipeline dp×pp with one stage per worker) run as scheduled
+#     gang jobs (gang_flows_ok + per-flow losses);
+#   * gang spans (reserve/rendezvous/step/release) must land in the trace
+#     stream (gang_spans_ok) and cordum_gang_* metrics in the fleet
+#     exposition (gang_metrics_ok);
+#   * gang_partial_reservations re-reads the ledger invariant counter
+#     (ceiling 0 in bench_floor.json).
+# ---------------------------------------------------------------------------
+
+
+def _gang_child(smoke: bool) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import faulthandler
+
+    faulthandler.dump_traceback_later(max(60.0, JAX_TIMEOUT_S), exit=True)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # cordumlint: disable=CL002 -- older jax without the config key; env var governs
+        pass
+    print(json.dumps(asyncio.run(_bench_gang(smoke))))
+
+
+async def _bench_gang(smoke: bool) -> dict:
+    from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+    from cordum_tpu.controlplane.scheduler.engine import Engine
+    from cordum_tpu.controlplane.scheduler.gang import GangScheduler
+    from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.infra.bus import LoopbackBus
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.jobstore import JobStore
+    from cordum_tpu.infra.kv import MemoryKV
+    from cordum_tpu.infra.memstore import MemoryStore
+    from cordum_tpu.infra.metrics import Metrics
+    from cordum_tpu.infra.registry import WorkerRegistry
+    from cordum_tpu.obs import FleetAggregator, TelemetryExporter
+    from cordum_tpu.protocol import subjects as subj
+    from cordum_tpu.protocol.types import (
+        BusPacket, JobRequest, LABEL_GANG_WORKERS,
+    )
+    from cordum_tpu.worker.gang import GangRunner
+    from cordum_tpu.worker.runtime import Worker
+    from cordum_tpu.worker.training import TrainRunner
+
+    kv = MemoryKV()
+    bus = LoopbackBus()
+    js = JobStore(kv)
+    kernel = SafetyKernel(policy_doc={
+        "tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}})
+    reg = WorkerRegistry()
+    pc = parse_pool_config({"topics": {"job.gang": "gangpool"},
+                            "pools": {"gangpool": {}}})
+    eng = Engine(bus=bus, job_store=js, safety=SafetyClient(kernel.check),
+                 strategy=LeastLoadedStrategy(reg, pc), registry=reg)
+    gangs = GangScheduler(eng, pc, rendezvous_timeout_s=10.0,
+                          watch_interval_s=0.05)
+    await eng.start()
+    await gangs.start()
+    spans: list = []
+
+    async def collect_span(subject, pkt):
+        spans.append(pkt.payload)
+
+    await bus.subscribe(subj.TRACE_SPAN, collect_span)
+    agg = FleetAggregator(bus, metrics=Metrics(), fine_step_s=0.5)
+    await agg.start()
+    exporter = TelemetryExporter(
+        "scheduler", bus, eng.metrics, instance_id="gang-sched",
+        interval_s=0.5,
+        health_fn=lambda: {"role": "scheduler", "gangs": gangs.doc(),
+                           "gang_queue_depth": len(gangs._fifo)},
+    )
+    store = MemoryStore(kv)
+    workers = []
+    for i in range(4):
+        w = Worker(bus=bus, store=store, worker_id=f"gw{i}", pool="gangpool",
+                   heartbeat_interval_s=0.5)
+        w.attach_gang(GangRunner(
+            w, trainer=TrainRunner(), rendezvous_timeout_s=10.0,
+            peer_timeout_s=60.0, beacon_interval_s=0.05,
+        ), metrics=eng.metrics)
+        await w.start()
+        workers.append(w)
+    await asyncio.sleep(0.1)
+
+    out: dict = {}
+
+    async def submit(job_id: str, payload: dict, n_workers: int) -> None:
+        ptr = await store.put_context(job_id, payload)
+        await bus.publish(subj.SUBMIT, BusPacket.wrap(
+            JobRequest(job_id=job_id, topic="job.gang", tenant_id="default",
+                       context_ptr=ptr,
+                       labels={LABEL_GANG_WORKERS: str(n_workers)}),
+            sender_id="bench"))
+
+    async def wait_done(job_ids, timeout_s: float) -> dict:
+        deadline = time.monotonic() + timeout_s
+        states = {}
+        while time.monotonic() < deadline:
+            states = {j: await js.get_state(j) for j in job_ids}
+            if all(s in ("SUCCEEDED", "FAILED", "DENIED", "CANCELLED")
+                   for s in states.values()):
+                break
+            await asyncio.sleep(0.05)
+        return states
+
+    try:
+        # -- 1. control-plane gang rate: barrier-only gangs of 2 over 4
+        # workers (two gangs run concurrently; the rest queue FIFO)
+        n_echo = 8 if smoke else 20
+        t0 = time.perf_counter()
+        for i in range(n_echo):
+            await submit(f"ge-{i}", {"op": "gang_echo"}, 2)
+        states = await wait_done([f"ge-{i}" for i in range(n_echo)], 120.0)
+        elapsed = time.perf_counter() - t0
+        ok = sum(1 for s in states.values() if s == "SUCCEEDED")
+        out["gang_echo_gangs"] = ok
+        out["gang_jobs_per_sec"] = round(ok / elapsed, 2) if elapsed else 0.0
+        if ok < n_echo:
+            out["gang_error"] = f"echo gangs: {states}"
+
+        # -- 2. the three MULTICHIP dryrun flows as scheduled gang jobs
+        flows = {
+            "dense": {"op": "train", "model": "llama-tiny", "steps": 1,
+                      "batch": 4, "seq": 16, "mesh": {"tp": 2, "sp": 2},
+                      "gang": {"workers": 2}},
+            "moe": {"op": "train", "model": "moe", "steps": 1,
+                    "batch": 4, "seq": 16, "mesh": {"tp": 2, "ep": 2},
+                    "gang": {"workers": 2}},
+            "pipeline": {"op": "train", "model": "pipeline", "steps": 1,
+                         "batch": 4, "seq": 16, "microbatches": 2,
+                         "mesh": {"dp": -1, "pp": 2},
+                         "gang": {"workers": 2}},
+        }
+        flows_ok = 1.0
+        for name, payload in flows.items():
+            await submit(f"gf-{name}", payload, 2)
+            states = await wait_done([f"gf-{name}"], 600.0)
+            if states.get(f"gf-{name}") != "SUCCEEDED":
+                flows_ok = 0.0
+                out["gang_error"] = (
+                    out.get("gang_error", "")
+                    + f" flow {name}: {states.get(f'gf-{name}')}"
+                ).strip()
+                continue
+            res = await store.get_result(f"gf-{name}")
+            loss = res.get("loss")
+            out[f"gang_{name}_loss"] = loss
+            out[f"gang_{name}_mode"] = res.get("mode")
+            if loss is None or not math.isfinite(float(loss)):
+                flows_ok = 0.0
+                out["gang_error"] = (
+                    out.get("gang_error", "") + f" flow {name}: loss={loss}"
+                ).strip()
+        out["gang_flows_ok"] = flows_ok
+
+        # -- 3. gang spans in the trace stream (the waterfall's source)
+        for _ in range(20):
+            await bus.drain()
+            await asyncio.sleep(0.01)
+        names = {sp.name for sp in spans}
+        want = {"gang-reserve", "gang-dispatch", "gang-rendezvous",
+                "gang-step", "gang-release"}
+        out["gang_spans_ok"] = 1.0 if want <= names else 0.0
+        if want - names:
+            out["gang_error"] = (
+                out.get("gang_error", "")
+                + f" missing spans: {sorted(want - names)}"
+            ).strip()
+
+        # -- 4. cordum_gang_* metrics in the fleet exposition
+        await exporter.publish_once()
+        await bus.drain()
+        text = agg.render()
+        out["gang_metrics_ok"] = 1.0 if (
+            "cordum_gang_admissions_total" in text
+            and "cordum_gang_rendezvous_seconds" in text
+        ) else 0.0
+        gdoc = agg.gangs_doc()
+        out["gang_table_rows"] = len(gdoc.get("gangs") or [])
+
+        # -- 5. the all-or-nothing invariant counter (ceiling 0)
+        gangs.ledger.verify()
+        out["gang_partial_reservations"] = (
+            eng.metrics.gang_partial_reservations.total())
+        out.setdefault("gang_error", "")
+    finally:
+        await exporter.stop()
+        await agg.stop()
+        await gangs.stop()
+        await eng.stop()
+        for w in workers:
+            await w.stop()
+        await bus.close()
+    return out
+
+
+_GANG_KEYS = (
+    "gang_jobs_per_sec", "gang_echo_gangs", "gang_flows_ok",
+    "gang_dense_loss", "gang_dense_mode", "gang_moe_loss", "gang_moe_mode",
+    "gang_pipeline_loss", "gang_pipeline_mode", "gang_spans_ok",
+    "gang_metrics_ok", "gang_table_rows", "gang_partial_reservations",
+    "gang_error",
+)
+
+
+def bench_gang(*, smoke: bool = False) -> dict:
+    """Run the gang bench in a child process (it must force the 8-device
+    CPU host platform before jax initializes; the parent may already hold
+    an initialized single-device backend)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--gang-child"]
+            + (["smoke"] if smoke else []),
+            capture_output=True, text=True, timeout=max(600.0, JAX_TIMEOUT_S),
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        line = (proc.stdout.strip().splitlines() or [""])[-1]
+        child = json.loads(line) if line.startswith("{") else {}
+        if not child:
+            tail = (proc.stderr or proc.stdout or "")[-600:]
+            return {"gang_error": f"gang child rc={proc.returncode}: {tail}",
+                    "gang_jobs_per_sec": 0.0, "gang_flows_ok": 0.0,
+                    "gang_partial_reservations": 0.0}
+        return {k: child[k] for k in _GANG_KEYS if k in child}
+    except subprocess.TimeoutExpired:
+        return {"gang_error": "gang child timed out",
+                "gang_jobs_per_sec": 0.0, "gang_flows_ok": 0.0,
+                "gang_partial_reservations": 0.0}
+    except Exception as ex:  # noqa: BLE001 - bench must report, not crash
+        return {"gang_error": f"{type(ex).__name__}: {ex}"[:300],
+                "gang_jobs_per_sec": 0.0, "gang_flows_ok": 0.0,
+                "gang_partial_reservations": 0.0}
 
 
 # ---------------------------------------------------------------------------
@@ -2078,6 +2330,20 @@ def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--shard-child":
         _shard_child(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
         return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--gang-child":
+        _gang_child("smoke" in sys.argv[2:])
+        return
+    if "--gang" in sys.argv:
+        # gang-scheduling mode (ISSUE 15): barrier-only gang throughput +
+        # the three MULTICHIP dryrun flows (dense/moe/MPMD-pipeline) as
+        # scheduled gang jobs through the real submit → reserve →
+        # rendezvous → result pipeline.  One JSON line, same gang_* keys
+        # as the full bench so bench_floor.json gates both surfaces.
+        out = {"metric": "gang_jobs_per_sec", "unit": "gangs/s"}
+        out.update(bench_gang(smoke="--smoke" in sys.argv))
+        out["value"] = out.get("gang_jobs_per_sec", 0.0)
+        print(json.dumps(out))
+        return
     if "--storm" in sys.argv:
         # storm-only mode (ISSUE 13): the multi-tenant overload harness —
         # admission on vs the control run.  One JSON line, same storm_*
@@ -2137,6 +2403,7 @@ def main() -> None:
     prof = bench_profile() if profile else None
     affinity = bench_session_affinity()
     storm = asyncio.run(bench_storm(smoke=smoke))
+    gang = bench_gang(smoke=smoke)
     jx = bench_jax(smoke=smoke)
     out = {
         "metric": "scheduled_jobs_per_sec",
@@ -2249,6 +2516,11 @@ def main() -> None:
         # batch absorbs the shedding, and the admission-disabled control
         # run degrades (floors/ceilings in bench_floor.json)
         **storm,
+        # gang scheduling (ISSUE 15): barrier-only gang rate + the three
+        # MULTICHIP flows as scheduled gang jobs (gang_jobs_per_sec /
+        # gang_flows_ok floors + the gang_partial_reservations == 0
+        # all-or-nothing invariant ceiling live in bench_floor.json)
+        **gang,
     }
     if smoke:
         out["smoke"] = True
@@ -2262,7 +2534,7 @@ def main() -> None:
             out[k] = jx[k]
     degraded = bool(out["embed_error"] or out["model_error"]
                     or out["batched_error"] or out["serving_error"]
-                    or out["disagg_error"])
+                    or out["disagg_error"] or out.get("gang_error"))
     out["degraded"] = degraded
     if degraded:
         out["child_traceback"] = jx.get("child_traceback", "")

@@ -353,6 +353,20 @@ def cmd_capacity(args) -> None:
     print(render_capacity_table(doc))
 
 
+def cmd_gangs(args) -> None:
+    """The fleet's live gang table (GET /api/v1/gangs): gang id, job,
+    state, member workers, rendezvous/done progress, age — merged from
+    every scheduler shard's beacon (docs/GANG.md)."""
+    from .controlplane.scheduler.gang import render_gang_table
+
+    with _client() as c:
+        doc = _check(c.get("/api/v1/gangs"))
+    if args.json:
+        _print(doc)
+        return
+    print(render_gang_table(doc))
+
+
 def cmd_admission(args) -> None:
     """Live admission-controller state (GET /api/v1/admission): per-(op,
     class) headroom against measured capacity, the current brownout tier,
@@ -540,6 +554,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="fleet op x worker throughput matrix (GET /api/v1/capacity)")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_capacity)
+
+    sp = sub.add_parser(
+        "gangs",
+        help="live gang table: mesh shape, members, state, age "
+             "(GET /api/v1/gangs)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_gangs)
 
     sp = sub.add_parser(
         "admission",

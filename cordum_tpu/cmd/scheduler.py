@@ -125,6 +125,23 @@ async def main() -> None:
         shard_index=shard_index,
         shard_count=max(1, shard_count),
     )
+    # gang scheduling (docs/GANG.md): all-or-nothing multi-worker placement
+    # for jobs carrying the gateway-stamped cordum.gang_workers label;
+    # SCHEDULER_GANG=0 / gang.enabled opts out
+    gangs = None
+    gang_cfg = pool_cfg.gang
+    if (
+        os.environ.get("SCHEDULER_GANG", "1") != "0"
+        and gang_cfg.get("enabled", True)
+    ):
+        from ..controlplane.scheduler.gang import GangScheduler
+
+        gangs = GangScheduler(
+            engine, pool_cfg,
+            rendezvous_timeout_s=float(
+                gang_cfg.get("rendezvous_timeout_s", 10.0)),
+            queued_timeout_s=float(gang_cfg.get("queued_timeout_s", 300.0)),
+        )
     reconciler = Reconciler(job_store, timeouts, instance_id=engine.instance_id)
     replayer = PendingReplayer(engine, job_store, timeouts)
     # serving-session crash failover: dead workers' in-flight jobs are
@@ -142,7 +159,7 @@ async def main() -> None:
     profiler = RuntimeProfiler(metrics, service="scheduler")
 
     def _telemetry_health() -> dict:
-        return {
+        out = {
             "role": "scheduler",
             "shard_index": engine.shard_index,
             "shard_count": engine.shard_count,
@@ -151,6 +168,12 @@ async def main() -> None:
             "workers_live": len(registry.snapshot()),
             **profiler.health(),
         }
+        if gangs is not None:
+            # live gang table (docs/GANG.md): merged fleet-wide by the
+            # gateway aggregator into GET /api/v1/gangs
+            out["gangs"] = gangs.doc()
+            out["gang_queue_depth"] = len(gangs._fifo)
+        return out
 
     telemetry = TelemetryExporter(
         "scheduler", bus, metrics,
@@ -189,6 +212,8 @@ async def main() -> None:
 
     moved_sub = await bus.subscribe(subj.SERVING_MOVED, _on_session_moved)
     await engine.start()
+    if gangs is not None:
+        await gangs.start()
     await reconciler.start()
     await replayer.start()
     await failover.start()
@@ -206,6 +231,8 @@ async def main() -> None:
         if rebalancer is not None:
             await rebalancer.stop()
         moved_sub.unsubscribe()
+        if gangs is not None:
+            await gangs.stop()
         await profiler.stop()
         await telemetry.stop()
         await snapshotter.stop()

@@ -130,6 +130,12 @@ async def main() -> None:
         or (pool.serving_prefill_budget if pool else 0) or 16,
         serving_handoff_tokens=_boot.env_int("WORKER_SERVING_HANDOFF_TOKENS", 0)
         or (pool.serving_handoff_tokens if pool else 0),
+        # gang scheduling (docs/GANG.md): member jobs rendezvous + run the
+        # SPMD/MPMD step program; WORKER_GANG=0 opts the worker out
+        gang=env.get("WORKER_GANG", "1") != "0",
+        gang_rendezvous_timeout_s=_boot.env_float(
+            "WORKER_GANG_RENDEZVOUS_TIMEOUT", 10.0),
+        gang_peer_timeout_s=_boot.env_float("WORKER_GANG_PEER_TIMEOUT", 30.0),
     )
     profiler = RuntimeProfiler(metrics, service="worker")
     telemetry = TelemetryExporter(

@@ -65,6 +65,8 @@ from ...protocol.types import (
     LABEL_APPROVAL_GRANTED,
     LABEL_BATCH_KEY,
     LABEL_BUS_MSG_ID,
+    LABEL_GANG_CHIPS,
+    LABEL_GANG_WORKERS,
     LABEL_OP,
     LABEL_SECRETS_PRESENT,
     LABEL_SESSION_KEY,
@@ -72,6 +74,7 @@ from ...protocol.types import (
     TERMINAL_STATES,
     WorkerDrain,
     payload_batch_key,
+    payload_gang,
     payload_session_key,
 )
 from ...utils.ids import new_id, now_us
@@ -271,6 +274,7 @@ class Gateway:
         r.add_get(f"{v1}/traces/{{trace_id}}", self.get_trace)
         r.add_get(f"{v1}/fleet", self.get_fleet)
         r.add_get(f"{v1}/capacity", self.get_capacity)
+        r.add_get(f"{v1}/gangs", self.get_gangs)
         r.add_get(f"{v1}/admission", self.get_admission)
         r.add_get(f"{v1}/workers", self.get_workers)
         r.add_post(f"{v1}/workers/{{worker_id}}/drain", self.drain_worker)
@@ -547,6 +551,18 @@ class Gateway:
         # ThroughputAwareStrategy's matrix lookup) never read the payload
         if LABEL_OP not in labels:
             labels[LABEL_OP] = op
+        # gang payloads (docs/GANG.md) carry their placement ask as labels
+        # so the scheduler's gang path (reserve N co-located workers,
+        # all-or-nothing) never reads the payload behind the context pointer
+        gspec = payload_gang(payload)
+        if gspec is not None and LABEL_GANG_WORKERS not in labels:
+            labels[LABEL_GANG_WORKERS] = str(int(gspec.get("workers", 1)))
+            try:
+                chips = int(gspec.get("chips_per_worker", 0) or 0)
+            except (TypeError, ValueError):
+                chips = 0
+            if chips > 0:
+                labels[LABEL_GANG_CHIPS] = str(chips)
         meta_doc = body.get("metadata") or {}
         metadata = JobMetadata(
             capability=str(meta_doc.get("capability", "")),
@@ -1466,6 +1482,12 @@ class Gateway:
         per-(op, class) headroom, current brownout tier, per-tenant bucket
         levels (`cordumctl admission`, docs/ADMISSION.md)."""
         return web.json_response(self.admission.doc())
+
+    async def get_gangs(self, request: web.Request) -> web.Response:
+        """``GET /api/v1/gangs`` — the live gang table merged from the
+        scheduler shards' health beacons (`cordumctl gangs`,
+        docs/GANG.md)."""
+        return web.json_response(self.fleet.gangs_doc())
 
     async def get_metrics(self, request: web.Request) -> web.Response:
         # ?scope=fleet: the aggregator's fleet-merged exposition (counters/

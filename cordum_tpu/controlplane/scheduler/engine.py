@@ -59,6 +59,7 @@ from ...protocol.types import (
     PolicyCheckRequest,
     STATUS_HINT_STREAM,
     TERMINAL_STATES,
+    gang_workers,
 )
 from .safety_client import SafetyClient
 from .strategy import Strategy
@@ -220,6 +221,10 @@ class Engine:
         self._preempt_cooldown: dict[str, float] = {}
         self._preempt_tasks: set[asyncio.Task] = set()
         self._preempt_scan: Optional[asyncio.Task] = None
+        # gang scheduling (docs/GANG.md): attached by GangScheduler's
+        # constructor; submits carrying cordum.gang_workers depart the
+        # single-worker dispatch path at _post_decision
+        self.gangs = None
         # kv round-trip accounting (cordum_kv_roundtrips_total{op}) for the
         # store this engine drives — the bench's kv_roundtrips_per_job source
         job_store.kv.bind_metrics(self.metrics)
@@ -363,6 +368,10 @@ class Engine:
             return
         if await self.job_store.cancel_job(c.job_id):
             await self.job_store.append_event(c.job_id, "cancelled", reason=c.reason)
+            if self.gangs is not None:
+                # a cancelled gang job aborts its whole gang (members stop,
+                # devices release) without a requeue
+                await self.gangs.on_cancel(c.job_id)
 
     # ------------------------------------------------------------------
     # batch preemption (docs/ADMISSION.md §Preemption): the telemetry
@@ -613,7 +622,8 @@ class Engine:
                 continue  # pre-stage already failed this item
             resp = it.resp
             gated = bool(self._tenant_limit(it.req) and it.req.tenant_id)
-            if resp.decision == Decision.ALLOW.value and not gated:
+            is_gang = self.gangs is not None and gang_workers(it.req.labels) > 0
+            if resp.decision == Decision.ALLOW.value and not gated and not is_gang:
                 simple.append(it)
             else:
                 complex_.append(it)
@@ -1108,6 +1118,16 @@ class Engine:
             extra_ops += self.job_store.register_deadline_ops(
                 req.job_id, req.budget.deadline_unix_ms
             )
+
+        # gang jobs depart here (docs/GANG.md): the gang scheduler owns
+        # reservation, fan-out dispatch, and attempts accounting — a queued
+        # gang leaves the job PENDING so the replayer keeps it alive
+        if self.gangs is not None and gang_workers(req.labels) > 0:
+            await self.gangs.on_submit(
+                req, extra_ops=extra_ops, pending_fields=pending_fields,
+                trace_id=trace_id, parent_span_id=parent_span_id,
+            )
+            return
 
         # dispatch-attempts guard: counted only for real dispatch attempts so
         # backpressure redeliveries (throttle / tenant concurrency) don't burn

@@ -100,6 +100,50 @@ def _parse_tpu_requires(requires: list[str]) -> tuple[list[str], int, str]:
     return caps, min_chips, topology
 
 
+def pool_requirement_mismatch(hb: Heartbeat, pool: Optional[Pool]) -> str:
+    """Why a worker fails its pool's slice-requirement keys (pools.yaml
+    ``min_chips`` / ``topology`` / ``device_kind``), or ``""`` when it
+    passes.  Split out so the exclusion can be *announced* — a worker
+    silently dropped from its own pool's routing is a misconfiguration the
+    operator should hear about once, not discover via starvation."""
+    if pool is None:
+        return ""
+    if pool.min_chips and hb.chip_count < pool.min_chips:
+        return (f"advertises {hb.chip_count} chips < pool min_chips "
+                f"{pool.min_chips}")
+    if pool.topology and hb.slice_topology != pool.topology:
+        return (f"topology {hb.slice_topology or '(none)'} != pool topology "
+                f"{pool.topology}")
+    if pool.device_kind and hb.device_kind and hb.device_kind != pool.device_kind:
+        return (f"device_kind {hb.device_kind!r} != pool device_kind "
+                f"{pool.device_kind!r}")
+    return ""
+
+
+# one-shot pool-exclusion warnings: (worker_id, pool_name) pairs already
+# announced (capped so an unbounded worker churn can't grow it forever)
+_POOL_EXCLUSION_WARNED: set[tuple[str, str]] = set()
+_POOL_EXCLUSION_WARN_CAP = 4096
+
+
+def warn_pool_exclusion(hb: Heartbeat, pool: Optional[Pool]) -> None:
+    """Log ONCE per (worker, pool) when a worker is excluded from a pool's
+    routing by the pool's slice-requirement keys."""
+    reason = pool_requirement_mismatch(hb, pool)
+    if not reason or pool is None:
+        return
+    key = (hb.worker_id, pool.name)
+    if key in _POOL_EXCLUSION_WARNED:
+        return
+    if len(_POOL_EXCLUSION_WARNED) >= _POOL_EXCLUSION_WARN_CAP:
+        _POOL_EXCLUSION_WARNED.clear()
+    _POOL_EXCLUSION_WARNED.add(key)
+    from ...infra import logging as logx
+
+    logx.warn("worker excluded from pool routing",
+              worker_id=hb.worker_id, pool=pool.name, reason=reason)
+
+
 def worker_satisfies(
     hb: Heartbeat, pool: Optional[Pool], job_requires: list[str]
 ) -> bool:
@@ -436,6 +480,10 @@ class LeastLoadedStrategy(Strategy):
                     continue
                 pool = matched[0]
             if not worker_satisfies(hb, pool, job_requires):
+                # pools.yaml min_chips/topology/device_kind exclusions are
+                # announced once per (worker, pool) — a worker dropped from
+                # its OWN pool's routing is a config problem, not noise
+                warn_pool_exclusion(hb, pool)
                 continue
             if placement and any(hb.labels.get(k) != v for k, v in placement.items()):
                 continue
@@ -507,6 +555,7 @@ class ThroughputAwareStrategy(LeastLoadedStrategy):
             if pool is None:
                 continue
             if not worker_satisfies(hb, pool, job_requires):
+                warn_pool_exclusion(hb, pool)
                 continue
             if is_overloaded(hb):
                 continue

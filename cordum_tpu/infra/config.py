@@ -116,6 +116,10 @@ class PoolConfig:
     # skew threshold, cooldown, moves per command) consumed by
     # DecodeRebalancer (docs/SERVING.md §Disaggregation)
     rebalancer: dict = field(default_factory=dict)
+    # gang: gang-scheduling knobs (rendezvous/peer timeouts) consumed by
+    # the scheduler's GangScheduler and the workers' GangRunner
+    # (docs/GANG.md)
+    gang: dict = field(default_factory=dict)
 
     def pools_for_topic(self, topic: str) -> list[Pool]:
         names = self.topics.get(topic)
@@ -163,6 +167,7 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
     cfg.slo = dict(doc.get("slo") or {})
     cfg.admission = dict(doc.get("admission") or {})
     cfg.rebalancer = dict(doc.get("rebalancer") or {})
+    cfg.gang = dict(doc.get("gang") or {})
     return cfg
 
 

@@ -178,6 +178,26 @@ POOLS_SCHEMA: dict[str, Any] = {
             },
             "additionalProperties": False,
         },
+        # gang scheduling (docs/GANG.md): scheduler-side reservation +
+        # worker-side rendezvous knobs for multi-chip SPMD/MPMD gangs
+        "gang": {
+            "type": "object",
+            "properties": {
+                "enabled": {"type": "boolean"},
+                # worker-side barrier timeout (the scheduler watchdog
+                # backstops at 2x before declaring the rendezvous dead)
+                "rendezvous_timeout_s": {
+                    "type": "number", "exclusiveMinimum": 0,
+                },
+                # MPMD stage-traffic wait: a peer silent for this long
+                # mid-step aborts the gang
+                "peer_timeout_s": {"type": "number", "exclusiveMinimum": 0},
+                # an unplaceable gang (no slice can EVER cover it) fails
+                # to the DLQ after queueing this long
+                "queued_timeout_s": {"type": "number", "exclusiveMinimum": 0},
+            },
+            "additionalProperties": False,
+        },
         # tolerated here so one file can carry pools + reconciler (dev mode)
         "reconciler": {"type": "object"},
     },

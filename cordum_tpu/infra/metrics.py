@@ -531,6 +531,46 @@ class Metrics:
             "handed the job back; redispatched = the job was re-dispatched "
             "attempts-exempt after the hold-off)",
         )
+        # gang scheduling (docs/GANG.md): mesh-aware all-or-nothing
+        # placement of multi-chip SPMD/MPMD jobs
+        self.gang_admissions = Counter(
+            "cordum_gang_admissions_total",
+            "Gang admission outcomes (reserved = all members reserved "
+            "at once; queued = parked in the exhaustion FIFO)",
+        )
+        self.gang_completed = Counter(
+            "cordum_gang_completed_total",
+            "Gangs that finished, by status (succeeded | failed)",
+        )
+        self.gang_aborts = Counter(
+            "cordum_gang_aborts_total",
+            "Whole-gang aborts, by reason (member_failed | worker_dead | "
+            "rendezvous_timeout | preempted | cancelled | ...)",
+        )
+        self.gang_partial_reservations = Counter(
+            "cordum_gang_partial_reservations_total",
+            "Ledger invariant violations: a gang observed holding fewer "
+            "devices than its full reservation (MUST stay 0 — all-or-"
+            "nothing admission is the design contract)",
+        )
+        self.gang_queue_depth = Gauge(
+            "cordum_gang_queue_depth",
+            "Gangs waiting in the exhaustion FIFO for devices to free",
+        )
+        self.gang_reserved_workers = Gauge(
+            "cordum_gang_reserved_workers",
+            "Workers currently reserved by running gangs",
+        )
+        self.gang_size = Histogram(
+            "cordum_gang_size",
+            "Members per dispatched gang",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+        )
+        self.gang_rendezvous_seconds = Histogram(
+            "cordum_gang_rendezvous_seconds",
+            "Worker-side wait from member dispatch to barrier passage",
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+        )
         self.slo_burn_rate = Gauge(
             "cordum_slo_burn_rate",
             "SLO error-budget burn rate per objective and window "
@@ -609,6 +649,14 @@ class Metrics:
             self.admission_headroom,
             self.admission_tier,
             self.preemptions,
+            self.gang_admissions,
+            self.gang_completed,
+            self.gang_aborts,
+            self.gang_partial_reservations,
+            self.gang_queue_depth,
+            self.gang_reserved_workers,
+            self.gang_size,
+            self.gang_rendezvous_seconds,
             self.slo_burn_rate,
             self.eventloop_lag,
             self.slow_ticks,

@@ -531,6 +531,39 @@ class FleetAggregator:
             "ops": {op: round(v, 2) for op, v in sorted(ops.items())},
         }
 
+    def gangs_doc(self) -> dict:
+        """``GET /api/v1/gangs`` — the live gang table (docs/GANG.md),
+        merged from every scheduler shard's health beacon (each shard
+        beacons the gangs it owns, so the union is the fleet view)."""
+        now = time.monotonic()
+        gangs: list[dict] = []
+        queue_depth = 0
+        shards = 0
+        for inst in sorted(self._instances.values(),
+                           key=lambda i: (i.service, i.instance)):
+            if inst.service != "scheduler":
+                continue
+            rows = inst.health.get("gangs")
+            if rows is None:
+                continue
+            shards += 1
+            fresh = self._healthy(inst, now)
+            for g in rows:
+                doc = dict(g)
+                doc["shard"] = inst.instance
+                doc["stale"] = not fresh
+                gangs.append(doc)
+            try:
+                queue_depth += int(inst.health.get("gang_queue_depth", 0) or 0)
+            except (TypeError, ValueError):
+                pass
+        return {
+            "ts_us": now_us(),
+            "gangs": gangs,
+            "queue_depth": queue_depth,
+            "scheduler_shards": shards,
+        }
+
     def _merged_exemplars(
         self, name: str, lk: LabelKey
     ) -> dict[int, tuple[str, float, int]]:

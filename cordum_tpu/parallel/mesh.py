@@ -43,11 +43,21 @@ class MeshSpec:
     pp: int = 1
 
     def resolve(self, n_devices: int) -> dict[str, int]:
+        if n_devices < 1:
+            raise ValueError("need at least one device")
         sizes = {"dp": self.dp, "tp": self.tp, "sp": self.sp, "ep": self.ep, "pp": self.pp}
+        bad = {k: v for k, v in sizes.items() if v != -1 and v < 1}
+        if bad:
+            # 0 / negative axes must fail loudly: a zero axis used to slip
+            # through `prod(v for v in ... if v > 0)` and build a 0-sized
+            # mesh dimension downstream
+            raise ValueError(f"mesh axes must be -1 or >= 1, got {bad}")
         fixed = math.prod(v for v in sizes.values() if v > 0)
         free = [k for k, v in sizes.items() if v == -1]
         if len(free) > 1:
             raise ValueError("at most one mesh axis may be -1")
+        if fixed > n_devices:
+            raise ValueError(f"mesh {sizes} needs {fixed} devices, have {n_devices}")
         if free:
             if n_devices % fixed:
                 raise ValueError(f"{n_devices} devices not divisible by fixed axes {fixed}")

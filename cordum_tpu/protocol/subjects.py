@@ -33,6 +33,16 @@ ADMISSION_PRESSURE = "sys.admission.pressure"
 # only delays one move.
 SERVING_MOVED = "sys.serving.moved"
 SERVING_REBALANCE = "sys.serving.rebalance"
+# gang scheduling (docs/GANG.md): every multi-chip gang owns one subject,
+# ``sys.job.gang.<gang_id>``, carrying its whole coordination traffic —
+# member rendezvous beacons, the abort fan-out, per-member completion
+# reports, and MPMD stage activations/cotangents.  Fan-out (members and the
+# owning scheduler shard all subscribe) and deliberately NOT durable: gang
+# coordination is live state — a lost beacon is re-published by the member's
+# rendezvous loop, and a wedged gang is recovered by the scheduler-side
+# watchdog (rendezvous timeout / dead-member abort), never by redelivery.
+GANG_PREFIX = "sys.job.gang."
+GANG_WILDCARD = "sys.job.gang.>"
 JOB_EVENTS_WILDCARD = "sys.job.>"  # every job lifecycle event (gateway tap)
 TRACE_SPAN = "sys.trace.span"  # finished flight-recorder spans → collector
 
@@ -48,6 +58,11 @@ TELEMETRY_WILDCARD = "sys.telemetry.>"
 def telemetry_subject(service: str) -> str:
     """Telemetry snapshot subject for a service (``sys.telemetry.<service>``)."""
     return f"{TELEMETRY_PREFIX}{service}"
+
+
+def gang_subject(gang_id: str) -> str:
+    """Coordination subject for one gang (``sys.job.gang.<gang_id>``)."""
+    return f"{GANG_PREFIX}{gang_id}"
 
 JOB_PREFIX = "job."
 WORKER_PREFIX = "worker."

@@ -429,6 +429,40 @@ class AdmissionPressure(WireModel):
 
 
 @dataclass
+class GangMsg(WireModel):
+    """One gang-coordination message on ``sys.job.gang.<gang_id>``
+    (docs/GANG.md).  A single wire shape serves the whole gang protocol:
+
+    * ``kind="ready"`` — a member's rendezvous beacon, re-published every
+      few hundred ms until the barrier passes (fan-out subjects are not
+      durable, so a beacon that raced a peer's subscribe is simply
+      repeated).
+    * ``kind="abort"`` — any member (or the scheduler watchdog) aborting
+      the WHOLE gang: peers stop between steps, the scheduler releases
+      every reserved device and requeues the job attempts-bounded.
+    * ``kind="done"`` — a member's completion report; ``stats`` carries its
+      result doc (loss, steps, mesh).  The owning scheduler shard
+      aggregates all ranks into the job's single terminal result.
+    * ``kind="stage"`` — MPMD pipeline traffic: the activation (forward)
+      or cotangent (backward) tensor for ``to_rank``, addressed by the
+      unique ``tag`` (``fwd:<step>:<microbatch>`` / ``bwd:...``); ``data``
+      is the raw float32 buffer, ``shape`` its dims.
+    """
+
+    gang_id: str = ""
+    job_id: str = ""
+    kind: str = ""  # ready | abort | done | stage
+    rank: int = -1
+    to_rank: int = -1  # stage messages: the addressed member
+    worker_id: str = ""
+    reason: str = ""  # abort cause
+    tag: str = ""  # stage routing key (unique per step/microbatch/direction)
+    data: bytes = b""  # stage tensor payload (raw little-endian float32)
+    shape: list[int] = field(default_factory=list)
+    stats: dict[str, Any] = field(default_factory=dict)  # done: member result
+
+
+@dataclass
 class SystemAlert(WireModel):
     severity: str = "info"
     source: str = ""
@@ -566,6 +600,7 @@ _PAYLOAD_TYPES: dict[str, type] = {
     "admission_pressure": AdmissionPressure,
     "session_moved": SessionMoved,
     "session_rebalance": SessionRebalance,
+    "gang_msg": GangMsg,
     "system_alert": SystemAlert,
     "span": Span,
     "telemetry": TelemetrySnapshot,
@@ -780,6 +815,10 @@ class BusPacket(WireModel):
         return self.payload if self.kind == "session_rebalance" else None
 
     @property
+    def gang_msg(self) -> Optional[GangMsg]:
+        return self.payload if self.kind == "gang_msg" else None
+
+    @property
     def system_alert(self) -> Optional[SystemAlert]:
         return self.payload if self.kind == "system_alert" else None
 
@@ -930,3 +969,61 @@ def payload_session_key(payload: Any) -> str:
         if isinstance(sid, str):
             return sid
     return ""
+
+
+# ---------------------------------------------------------------------------
+# gang scheduling declaration (docs/GANG.md)
+# ---------------------------------------------------------------------------
+
+# A gang job's payload carries a ``gang`` stanza next to its ``mesh``:
+#
+#   {"op": "train", "model": "llama-tiny", "steps": 2,
+#    "mesh": {"dp": -1, "tp": 2, "sp": 2},
+#    "gang": {"workers": 2, "chips_per_worker": 8}}
+#
+# The gateway stamps the stanza as routing labels at submit (mirroring
+# LABEL_OP/LABEL_SESSION_KEY) so the scheduler's gang path never reads the
+# payload behind the context pointer.  The scheduler-stamped dispatch
+# labels (gang id / rank / size / members) tell each worker its place in
+# the gang; they are routing metadata, excluded from the approval job hash.
+
+# submit-time labels (gateway ← payload["gang"])
+LABEL_GANG_WORKERS = "cordum.gang_workers"  # members requested (all-or-nothing)
+LABEL_GANG_CHIPS = "cordum.gang_chips"  # min chips each member must own
+
+# dispatch-time labels (gang scheduler → members)
+LABEL_GANG_ID = "cordum.gang_id"
+LABEL_GANG_RANK = "cordum.gang_rank"
+LABEL_GANG_SIZE = "cordum.gang_size"
+LABEL_GANG_MEMBERS = "cordum.gang_members"  # comma-joined worker ids, rank order
+
+
+def payload_gang(payload: Any) -> Optional[dict]:
+    """The payload's ``gang`` stanza when it requests gang placement
+    (``workers >= 1``), else None."""
+    if not isinstance(payload, dict):
+        return None
+    g = payload.get("gang")
+    if not isinstance(g, dict):
+        return None
+    try:
+        if int(g.get("workers", 0)) < 1:
+            return None
+    except (TypeError, ValueError):
+        return None
+    return g
+
+
+def gang_workers(labels: Optional[dict]) -> int:
+    """Members a gang-labeled request asks for (0 = not a gang job)."""
+    try:
+        return max(0, int((labels or {}).get(LABEL_GANG_WORKERS, "0") or 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def gang_chips(labels: Optional[dict]) -> int:
+    try:
+        return max(0, int((labels or {}).get(LABEL_GANG_CHIPS, "0") or 0))
+    except (TypeError, ValueError):
+        return 0

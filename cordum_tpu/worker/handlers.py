@@ -462,12 +462,15 @@ def attach_default_tpu_worker(
     serving_max_new_tokens: int = 64,
     serving_prefill_budget: int = 16,
     serving_handoff_tokens: int = 0,
+    gang: bool = True,
+    gang_rendezvous_timeout_s: float = 10.0,
+    gang_peer_timeout_s: float = 30.0,
     metrics=None,
     **kw,
 ) -> TPUCompute:
     """Wire the standard TPU op handlers (and, by default, the micro-batcher
-    over the batchable ops plus the llm.generate serving engine) onto a
-    worker."""
+    over the batchable ops, the llm.generate serving engine, and the gang
+    runner for multi-chip gang member jobs) onto a worker."""
     compute = TPUCompute(tp=tp, **kw)
     worker.register_default(make_tpu_handlers(compute))
     if batching:
@@ -486,4 +489,14 @@ def attach_default_tpu_worker(
             handoff_tokens=serving_handoff_tokens,
             metrics=metrics,
         ))
+    if gang:
+        from .gang import GangRunner
+        from .training import TrainRunner
+
+        worker.attach_gang(GangRunner(
+            worker,
+            trainer=TrainRunner(),
+            rendezvous_timeout_s=gang_rendezvous_timeout_s,
+            peer_timeout_s=gang_peer_timeout_s,
+        ), metrics=metrics)
     return compute

@@ -69,6 +69,7 @@ from ..serving.engine import (
 )
 from ..serving.migration import MigrationError, MigrationServer, migrate_session
 from ..utils.ids import new_id
+from .gang import GangRunner
 
 HEARTBEAT_INTERVAL_S = 10.0
 
@@ -199,6 +200,11 @@ class Worker:
         # — the waiter future wins the race against the acquire and the job
         # returns to the scheduler as a non-terminal SESSION_REQUEUE
         self._preempt_waiters: dict[str, asyncio.Future] = {}
+        # gang scheduling (docs/GANG.md): member jobs (cordum.gang_id label)
+        # route to the gang runner — rendezvous barrier + SPMD/MPMD step
+        # program; members publish GangMsg traffic, never JobResults
+        self._gang: Optional[GangRunner] = None
+        self.gang_metrics = None
         self._draining = False
         self._drained = asyncio.Event()
         self._drain_task: Optional[asyncio.Task] = None
@@ -260,6 +266,18 @@ class Worker:
     def serving(self) -> Optional[ServingEngine]:
         return self._serving
 
+    def attach_gang(self, runner: GangRunner, *, metrics=None) -> None:
+        """Wire a gang runner between job intake and the step programs.
+        Jobs carrying the scheduler-stamped gang labels bypass the handler
+        path (and the intake semaphore — the gang's device reservation is
+        the concurrency bound)."""
+        self._gang = runner
+        self.gang_metrics = metrics
+
+    @property
+    def gang(self) -> Optional[GangRunner]:
+        return self._gang
+
     async def run_in_executor(self, fn, *args):
         """Run a blocking JAX computation off the event loop."""
         return await asyncio.get_running_loop().run_in_executor(self._executor, fn, *args)
@@ -312,6 +330,9 @@ class Worker:
             await self._batcher.stop()  # drain queued batches before the pool dies
         if self._serving is not None:
             await self._serving.stop()  # evict sessions (they publish CANCELLED)
+        if self._gang is not None:
+            await self._gang.stop()  # cancel member tasks (crash semantics:
+            # no abort published — the scheduler watchdog recovers the gang)
         self._executor.shutdown(wait=False)
 
     # ------------------------------------------------------------------
@@ -711,11 +732,29 @@ class Worker:
             and req.job_id not in self._active
             and req.job_id not in self._completed
         ):
+            if self._gang is not None and GangRunner.is_member(req):
+                # a gang member landing mid-drain is dropped silently: the
+                # scheduler's gang watchdog sees the draining heartbeat and
+                # aborts/requeues the WHOLE gang (a SESSION_REQUEUE here
+                # would wrongly single-worker-redispatch the gang job)
+                return
             # new work routed here mid-drain (affinity raced the draining
             # beacon): hand it straight back for failover re-dispatch
             await self._publish_requeue(
                 req.job_id, "worker draining", trace_id=pkt.trace_id,
                 partition=(req.labels or {}).get(LABEL_PARTITION, ""),
+            )
+            return
+        if self._gang is not None and GangRunner.is_member(req):
+            # gang member: rendezvous + step program, no intake semaphore
+            # (the gang's device reservation is the concurrency bound) and
+            # no JobResult (the scheduler aggregates member reports)
+            payload = (
+                await self.store.get_pointer(req.context_ptr)
+                if req.context_ptr else None
+            )
+            await self._gang.handle(
+                req, payload, trace_id=pkt.trace_id, parent_span_id=pkt.span_id,
             )
             return
         payload: Any = _UNFETCHED

@@ -297,6 +297,14 @@ _HEALTHY_DISAGG = {
     "disagg_inter_token_p99_ms": 23.0,
 }
 
+# gang scheduling keys (ISSUE 15): the control-plane gang pipeline ran,
+# the three MULTICHIP flows completed, and the all-or-nothing invariant
+# counter stayed at exactly zero
+_HEALTHY_GANG = {
+    "gang_jobs_per_sec": 4.0, "gang_flows_ok": 1.0,
+    "gang_partial_reservations": 0.0,
+}
+
 
 def test_floor_checker_passes_healthy_doc():
     mod = _floor_mod()
@@ -310,7 +318,7 @@ def test_floor_checker_passes_healthy_doc():
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
-           **_HEALTHY_STORM, **_HEALTHY_DISAGG}
+           **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG}
     floors = json.loads((REPO / "bench_floor.json").read_text())
     assert mod.check(doc, floors) == []
 
@@ -330,7 +338,7 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
-           **_HEALTHY_STORM, **_HEALTHY_DISAGG}
+           **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG}
     violations = mod.check(doc, floors)
     assert violations and "value" in violations[0]
     # ceilings guard the other direction (round-trip budget regression)

@@ -1,0 +1,941 @@
+"""Gang scheduling (docs/GANG.md): DeviceLedger all-or-nothing invariants,
+FIFO admission, engine → rendezvous → aggregated-result flow, abort/requeue
+fault semantics (member failure, crash, rendezvous timeout, preemption,
+cancel), MPMD pipeline numerics, pool-requirement enforcement, the gang
+observability surfaces, and the MeshSpec.resolve edge cases."""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+import sys
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+from cordum_tpu.controlplane.scheduler.engine import Engine
+from cordum_tpu.controlplane.scheduler.gang import (
+    DeviceLedger,
+    GangScheduler,
+    render_gang_table,
+)
+from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+from cordum_tpu.controlplane.scheduler.strategy import (
+    LeastLoadedStrategy,
+    pool_requirement_mismatch,
+)
+from cordum_tpu.infra.bus import LoopbackBus
+from cordum_tpu.infra.config import Pool, parse_pool_config
+from cordum_tpu.infra.jobstore import JobStore
+from cordum_tpu.infra.kv import MemoryKV
+from cordum_tpu.infra.memstore import MemoryStore
+from cordum_tpu.infra.registry import WorkerRegistry
+from cordum_tpu.parallel.mesh import MeshSpec
+from cordum_tpu.protocol import subjects as subj
+from cordum_tpu.protocol.types import (
+    BusPacket,
+    GangMsg,
+    Heartbeat,
+    JobPreempt,
+    JobRequest,
+    LABEL_GANG_CHIPS,
+    LABEL_GANG_WORKERS,
+    gang_chips,
+    gang_workers,
+    payload_gang,
+)
+from cordum_tpu.worker.gang import GangRunner
+from cordum_tpu.worker.runtime import Worker
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# MeshSpec.resolve edge cases (satellite: previously only exercised by the
+# MULTICHIP dryruns)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_resolve_default_absorbs_all():
+    assert MeshSpec().resolve(8) == {"dp": 8, "tp": 1, "sp": 1, "ep": 1, "pp": 1}
+
+
+def test_mesh_resolve_fixed_exact_fit():
+    assert MeshSpec(dp=2, tp=2, sp=2).resolve(8)["dp"] == 2
+
+
+def test_mesh_resolve_free_axis_divides_remainder():
+    sizes = MeshSpec(dp=-1, tp=2, sp=2).resolve(8)
+    assert sizes["dp"] == 2 and sizes["tp"] == 2 and sizes["sp"] == 2
+
+
+def test_mesh_resolve_non_divisible_raises():
+    with pytest.raises(ValueError, match="not divisible"):
+        MeshSpec(dp=-1, tp=3).resolve(8)
+
+
+def test_mesh_resolve_axis_exceeds_devices_raises():
+    with pytest.raises(ValueError):
+        MeshSpec(dp=1, tp=16).resolve(8)
+    # a free axis cannot rescue an oversized fixed product either
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=16).resolve(8)
+
+
+def test_mesh_resolve_zero_axis_raises():
+    # regression: dp=0 used to slip through the fixed-axes product and
+    # build a zero-sized mesh dimension downstream
+    with pytest.raises(ValueError, match="axes must be"):
+        MeshSpec(dp=0, tp=2, sp=2, ep=2).resolve(8)
+    with pytest.raises(ValueError, match="axes must be"):
+        MeshSpec(tp=-2).resolve(8)
+
+
+def test_mesh_resolve_two_free_axes_raises():
+    with pytest.raises(ValueError, match="at most one"):
+        MeshSpec(dp=-1, tp=-1).resolve(8)
+
+
+def test_mesh_resolve_fixed_mismatch_raises():
+    with pytest.raises(ValueError, match="needs"):
+        MeshSpec(dp=2, tp=2).resolve(8)
+
+
+# ---------------------------------------------------------------------------
+# gang payload declaration + labels
+# ---------------------------------------------------------------------------
+
+
+def test_payload_gang_parsing():
+    assert payload_gang({"op": "train", "gang": {"workers": 2}}) == {"workers": 2}
+    assert payload_gang({"op": "train"}) is None
+    assert payload_gang({"gang": {"workers": 0}}) is None
+    assert payload_gang({"gang": {"workers": "x"}}) is None
+    assert payload_gang("nope") is None
+    assert gang_workers({LABEL_GANG_WORKERS: "3"}) == 3
+    assert gang_workers({LABEL_GANG_WORKERS: "bad"}) == 0
+    assert gang_workers(None) == 0
+    assert gang_chips({LABEL_GANG_CHIPS: "8"}) == 8
+
+
+# ---------------------------------------------------------------------------
+# DeviceLedger: all-or-nothing reservation
+# ---------------------------------------------------------------------------
+
+
+def _hb(wid, pool="gangpool", region="", chips=8, **kw):
+    return Heartbeat(worker_id=wid, pool=pool, region=region,
+                     chip_count=chips, max_parallel_jobs=8, **kw)
+
+
+def _pools():
+    return [Pool(name="gangpool")]
+
+
+def test_ledger_all_or_nothing_and_release():
+    reg = WorkerRegistry()
+    for i in range(3):
+        reg.update(_hb(f"w{i}"))
+    led = DeviceLedger(reg)
+    got = led.try_reserve("g1", 2, pools=_pools(), job_requires=[])
+    assert got is not None and len(got) == 2
+    # only one worker left: a 2-gang must get NOTHING, not one worker
+    assert led.try_reserve("g2", 2, pools=_pools(), job_requires=[]) is None
+    assert len(led.reserved_workers) == 2  # untouched by the failed attempt
+    assert led.verify() == 0
+    # release frees the full set and the next gang fits
+    assert led.release("g1") == 2
+    assert led.try_reserve("g2", 2, pools=_pools(), job_requires=[]) is not None
+    assert led.release("unknown") == 0  # benign double-release
+
+
+def test_ledger_respects_chips_and_slice_colocation():
+    reg = WorkerRegistry()
+    reg.update(_hb("small", chips=2))
+    reg.update(_hb("big1", chips=8))
+    reg.update(_hb("big2", chips=8))
+    # different region = different slice: cannot co-locate
+    reg.update(_hb("far", chips=8, region="other"))
+    led = DeviceLedger(reg)
+    got = led.try_reserve("g", 2, pools=_pools(), job_requires=[], chips=4)
+    assert got is not None and set(got) == {"big1", "big2"}
+    assert led.try_reserve("g2", 2, pools=_pools(), job_requires=[], chips=4) is None
+
+
+def test_ledger_excludes_draining_unhealthy_and_excluded():
+    reg = WorkerRegistry()
+    reg.update(_hb("ok1"))
+    reg.update(_hb("ok2"))
+    reg.update(_hb("drainy", draining=True))
+    reg.update(_hb("sick", devices_healthy=False))
+    led = DeviceLedger(reg)
+    got = led.try_reserve("g", 2, pools=_pools(), job_requires=[],
+                          exclude=("ok1",))
+    assert got is None  # only ok2 remains eligible
+    got = led.try_reserve("g", 2, pools=_pools(), job_requires=[])
+    assert got is not None and set(got) == {"ok1", "ok2"}
+
+
+def test_ledger_property_never_partial():
+    """Randomized admit/release interleavings: after EVERY operation the
+    ledger is either holding a gang's full member set or none of it — the
+    acceptance-bar property test."""
+    rng = random.Random(1234)
+    reg = WorkerRegistry()
+    n_workers = 7
+    for i in range(n_workers):
+        reg.update(_hb(f"w{i}"))
+    led = DeviceLedger(reg)
+    live: list[str] = []
+    seq = 0
+    for _ in range(2000):
+        op = rng.random()
+        if op < 0.55 or not live:
+            seq += 1
+            size = rng.randint(1, n_workers + 1)  # sometimes unsatisfiable
+            got = led.try_reserve(f"g{seq}", size, pools=_pools(),
+                                  job_requires=[])
+            if got is not None:
+                assert len(got) == size
+                live.append(f"g{seq}")
+            else:
+                # failed reservation must not strand anything
+                assert f"g{seq}" not in led.reserved_workers.values()
+        else:
+            gid = live.pop(rng.randrange(len(live)))
+            freed = led.release(gid)
+            assert freed > 0
+        assert led.verify() == 0
+        # reservation map and gang map agree in both directions
+        held = led.reserved_workers
+        for gid in live:
+            members = led.gang_members(gid)
+            assert members and all(held[w] == gid for w in members)
+        assert set(held.values()) == set(live)
+
+
+# ---------------------------------------------------------------------------
+# pool requirement enforcement (satellite: exclusion + one-shot warning)
+# ---------------------------------------------------------------------------
+
+
+def test_pool_requirement_mismatch_reasons():
+    pool = Pool(name="tpu", min_chips=4, topology="2x2x1",
+                device_kind="TPU v5p")
+    ok = Heartbeat(worker_id="w", chip_count=4, slice_topology="2x2x1",
+                   device_kind="TPU v5p")
+    assert pool_requirement_mismatch(ok, pool) == ""
+    assert "min_chips" in pool_requirement_mismatch(
+        Heartbeat(worker_id="w", chip_count=2, slice_topology="2x2x1",
+                  device_kind="TPU v5p"), pool)
+    assert "topology" in pool_requirement_mismatch(
+        Heartbeat(worker_id="w", chip_count=4, slice_topology="2x2x2",
+                  device_kind="TPU v5p"), pool)
+    assert "device_kind" in pool_requirement_mismatch(
+        Heartbeat(worker_id="w", chip_count=4, slice_topology="2x2x1",
+                  device_kind="TPU v4"), pool)
+    assert pool_requirement_mismatch(ok, None) == ""
+
+
+def test_pool_requirements_exclude_worker_with_one_shot_warning(caplog):
+    """A worker advertising fewer chips than its pool's min_chips is
+    excluded from that pool's routing and the exclusion is logged exactly
+    once per (worker, pool)."""
+    reg = WorkerRegistry()
+    reg.update(Heartbeat(worker_id="tiny", pool="tpu", chip_count=1,
+                         capabilities=["tpu"], max_parallel_jobs=8))
+    reg.update(Heartbeat(worker_id="full", pool="tpu", chip_count=8,
+                         capabilities=["tpu"], max_parallel_jobs=8))
+    pc = parse_pool_config({
+        "topics": {"job.tpu": "tpu"},
+        "pools": {"tpu": {"requires": ["tpu"], "min_chips": 4}},
+    })
+    strat = LeastLoadedStrategy(reg, pc, native=False)
+    req = JobRequest(job_id="j", topic="job.tpu")
+    with caplog.at_level(logging.WARNING, logger="cordum"):
+        assert strat.pick_subject(req) == "worker.full.jobs"
+        assert strat.pick_subject(req) == "worker.full.jobs"
+    warnings = [r for r in caplog.records
+                if "excluded from pool routing" in r.getMessage()]
+    assert len(warnings) == 1  # one-shot per (worker, pool)
+    assert warnings[0].kv["worker_id"] == "tiny"
+    assert "min_chips" in warnings[0].kv["reason"]
+
+
+# ---------------------------------------------------------------------------
+# engine → gang scheduler → worker rendezvous e2e
+# ---------------------------------------------------------------------------
+
+
+async def make_stack(n_workers=2, *, trainer=False, rendezvous_timeout_s=2.0,
+                     peer_timeout_s=5.0, registry_ttl_s=30.0,
+                     watch_interval_s=0.05, hb_interval_s=0.3):
+    from cordum_tpu.worker.training import TrainRunner
+
+    kv = MemoryKV()
+    bus = LoopbackBus()
+    js = JobStore(kv)
+    kernel = SafetyKernel(policy_doc={
+        "tenants": {"default": {"allow_topics": ["job.*", "job.>"]}},
+    })
+    reg = WorkerRegistry(ttl_s=registry_ttl_s)
+    pc = parse_pool_config({
+        "topics": {"job.gang": "gangpool", "job.single": "single"},
+        "pools": {"gangpool": {}, "single": {}},
+    })
+    eng = Engine(bus=bus, job_store=js, safety=SafetyClient(kernel.check),
+                 strategy=LeastLoadedStrategy(reg, pc), registry=reg)
+    gangs = GangScheduler(eng, pc, rendezvous_timeout_s=rendezvous_timeout_s,
+                          watch_interval_s=watch_interval_s)
+    await eng.start()
+    await gangs.start()
+    store = MemoryStore(kv)
+    workers = []
+    for i in range(n_workers):
+        w = Worker(bus=bus, store=store, worker_id=f"w{i}", pool="gangpool",
+                   heartbeat_interval_s=hb_interval_s)
+        w.attach_gang(GangRunner(
+            w, trainer=TrainRunner() if trainer else None,
+            rendezvous_timeout_s=rendezvous_timeout_s,
+            peer_timeout_s=peer_timeout_s, beacon_interval_s=0.05,
+        ), metrics=eng.metrics)
+        await w.start()
+        workers.append(w)
+    await asyncio.sleep(0.05)
+    stack = SimpleNamespace(kv=kv, bus=bus, js=js, eng=eng, gangs=gangs,
+                            store=store, workers=workers, reg=reg)
+    return stack
+
+
+async def teardown(stack) -> None:
+    await stack.gangs.stop()
+    await stack.eng.stop()
+    for w in stack.workers:
+        try:
+            await w.stop()
+        except Exception:
+            pass
+    await stack.bus.close()
+
+
+async def submit_gang(stack, job_id, payload, *, workers=2, chips=0,
+                      priority="BATCH"):
+    ptr = await stack.store.put_context(job_id, payload)
+    labels = {LABEL_GANG_WORKERS: str(workers)}
+    if chips:
+        labels[LABEL_GANG_CHIPS] = str(chips)
+    req = JobRequest(job_id=job_id, topic="job.gang", tenant_id="default",
+                     priority=priority, context_ptr=ptr, labels=labels)
+    await stack.bus.publish(subj.SUBMIT, BusPacket.wrap(req, sender_id="test"))
+    return req
+
+
+async def wait_state(js, job_id, want=("SUCCEEDED",), timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    st = None
+    while time.monotonic() < deadline:
+        st = await js.get_state(job_id)
+        if st in want or st in ("FAILED", "DENIED", "CANCELLED"):
+            return st
+        await asyncio.sleep(0.02)
+    return st
+
+
+async def test_gang_happy_path_aggregates_member_results():
+    stack = await make_stack(2)
+    try:
+        await submit_gang(stack, "g-happy", {"op": "gang_echo"}, workers=2)
+        assert await wait_state(stack.js, "g-happy") == "SUCCEEDED"
+        res = await stack.store.get_result("g-happy")
+        assert set(res["per_rank"]) == {"0", "1"}
+        assert sorted(res["workers"]) == ["w0", "w1"]
+        meta = await stack.js.get_meta("g-happy")
+        assert meta["dispatch_subject"].startswith(subj.GANG_PREFIX)
+        assert meta["gang_members"] in ("w0,w1", "w1,w0")
+        # full release + invariant intact + metrics counted
+        assert stack.gangs.ledger.reserved_workers == {}
+        assert stack.gangs.ledger.verify() == 0
+        m = stack.eng.metrics
+        assert m.gang_admissions.value(outcome="reserved") == 1
+        assert m.gang_completed.value(status="succeeded") == 1
+        assert m.gang_partial_reservations.total() == 0
+    finally:
+        await teardown(stack)
+
+
+async def test_gang_queueing_is_fifo_all_or_nothing():
+    """Two 2-gangs over two workers: the second queues (never half-
+    reserves) and runs after the first releases."""
+    stack = await make_stack(2)
+    try:
+        await submit_gang(stack, "g-a", {"op": "gang_test", "spin_s": 0.5},
+                          workers=2)
+        # give the first gang time to reserve, then pile the second on
+        await asyncio.sleep(0.15)
+        assert len(stack.gangs.ledger.reserved_workers) == 2
+        await submit_gang(stack, "g-b", {"op": "gang_test", "spin_s": 0.1},
+                          workers=2)
+        await asyncio.sleep(0.15)
+        # g-b is queued, not half-reserved; g-a still holds both workers
+        assert len(stack.gangs._fifo) == 1
+        assert set(stack.gangs.ledger.reserved_workers.values()) == {
+            stack.gangs._by_job["g-a"].gang_id}
+        assert await wait_state(stack.js, "g-a") == "SUCCEEDED"
+        assert await wait_state(stack.js, "g-b") == "SUCCEEDED"
+        assert stack.gangs.ledger.reserved_workers == {}
+        assert stack.eng.metrics.gang_admissions.value(outcome="queued") >= 1
+        assert stack.eng.metrics.gang_partial_reservations.total() == 0
+    finally:
+        await teardown(stack)
+
+
+async def test_gang_member_failure_aborts_all_and_requeues_excluding():
+    """Rank failure on one worker aborts the WHOLE gang, releases every
+    device, and the requeue excludes the failed worker — the job completes
+    on the survivors with attempts == 2."""
+    stack = await make_stack(3)
+    try:
+        # w0 fails its member; the requeue must land on {w1, w2}
+        await submit_gang(
+            stack, "g-fail",
+            {"op": "gang_test", "spin_s": 0.2, "fail_workers": ["w0"]},
+            workers=2,
+        )
+        assert await wait_state(stack.js, "g-fail") == "SUCCEEDED"
+        res = await stack.store.get_result("g-fail")
+        assert "w0" not in res["workers"]
+        meta = await stack.js.get_meta("g-fail")
+        assert meta["attempts"] == "2"
+        assert stack.gangs.ledger.reserved_workers == {}
+        m = stack.eng.metrics
+        assert m.gang_aborts.value(reason="member_failed") == 1
+        assert m.gang_partial_reservations.total() == 0
+    finally:
+        await teardown(stack)
+
+
+async def test_gang_persistent_failure_lands_in_dlq():
+    stack = await make_stack(2)
+    try:
+        dlq: list = []
+
+        async def on_dlq(subject, pkt):
+            dlq.append(pkt.job_result)
+
+        await stack.bus.subscribe(subj.DLQ, on_dlq)
+        await submit_gang(
+            stack, "g-doom",
+            {"op": "gang_test", "fail_workers": ["w0", "w1"]},
+            workers=2,
+        )
+        assert await wait_state(stack.js, "g-doom", timeout_s=40.0) == "FAILED"
+        await stack.bus.drain()
+        assert any(r.job_id == "g-doom" for r in dlq)
+        assert stack.gangs.ledger.reserved_workers == {}
+    finally:
+        await teardown(stack)
+
+
+async def test_gang_rendezvous_timeout_excludes_silent_member():
+    """A phantom worker (heartbeats, but never answers its member packet)
+    times out the barrier; the healthy member's abort excludes the silent
+    one and the retry completes on real workers."""
+    stack = await make_stack(2, rendezvous_timeout_s=0.5)
+    try:
+        # phantom: registry entry + a subscription that swallows the packet
+        stack.reg.update(_hb("ghost", pool="gangpool", chips=8))
+
+        async def swallow(subject, pkt):
+            return None
+
+        await stack.bus.subscribe(subj.direct_subject("ghost"), swallow,
+                                  queue="ghost")
+        ghost_beat = asyncio.ensure_future(_beat(stack, "ghost"))
+        try:
+            await submit_gang(stack, "g-rdv", {"op": "gang_echo"}, workers=2)
+            assert await wait_state(stack.js, "g-rdv", timeout_s=20.0) == "SUCCEEDED"
+        finally:
+            ghost_beat.cancel()
+        res = await stack.store.get_result("g-rdv")
+        assert "ghost" not in res["workers"]
+        assert stack.eng.metrics.gang_aborts.value(
+            reason="rendezvous_timeout") >= 1
+        assert stack.gangs.ledger.verify() == 0
+    finally:
+        await teardown(stack)
+
+
+async def _beat(stack, wid):
+    while True:
+        stack.reg.update(_hb(wid, pool="gangpool", chips=8))
+        await asyncio.sleep(0.1)
+
+
+async def test_gang_preempted_as_a_unit_attempts_exempt():
+    """A JobPreempt for a BATCH gang aborts the whole gang, requeues it
+    attempts-EXEMPT after the hold-off, and it completes."""
+    stack = await make_stack(2)
+    try:
+        await submit_gang(stack, "g-pre", {"op": "gang_test", "spin_s": 1.5},
+                          workers=2, priority="BATCH")
+        await asyncio.sleep(0.3)
+        rec = stack.gangs._by_job["g-pre"]
+        assert rec.state == "RUNNING"
+        await stack.bus.publish(subj.PREEMPT, BusPacket.wrap(
+            JobPreempt(job_id="g-pre", reason="slo_pressure"),
+            sender_id="governor"))
+        assert await wait_state(stack.js, "g-pre", timeout_s=20.0) == "SUCCEEDED"
+        meta = await stack.js.get_meta("g-pre")
+        assert meta["attempts"] == "1"  # the preempt re-dispatch was exempt
+        assert stack.eng.metrics.gang_aborts.value(reason="preempted") == 1
+        assert stack.gangs.ledger.reserved_workers == {}
+    finally:
+        await teardown(stack)
+
+
+async def test_gang_cancel_aborts_without_requeue():
+    stack = await make_stack(2)
+    try:
+        await submit_gang(stack, "g-can", {"op": "gang_test", "spin_s": 5.0},
+                          workers=2)
+        await asyncio.sleep(0.3)
+        from cordum_tpu.protocol.types import JobCancel
+
+        await stack.bus.publish(subj.CANCEL, BusPacket.wrap(
+            JobCancel(job_id="g-can", reason="test"), sender_id="test"))
+        assert await wait_state(stack.js, "g-can", timeout_s=10.0) == "CANCELLED"
+        # devices released, no requeue record lingering
+        for _ in range(50):
+            if not stack.gangs.ledger.reserved_workers:
+                break
+            await asyncio.sleep(0.05)
+        assert stack.gangs.ledger.reserved_workers == {}
+        assert "g-can" not in stack.gangs._by_job
+        # members stopped spinning (their active sets drain)
+        for _ in range(100):
+            if all(not w._active for w in stack.workers):
+                break
+            await asyncio.sleep(0.05)
+        assert all(not w._active for w in stack.workers)
+    finally:
+        await teardown(stack)
+
+
+async def test_gang_member_crash_mid_step_recovers_on_survivors():
+    """The chaos twin of the acceptance bar: one member crashes mid-step
+    (worker torn down abruptly — no abort published, heartbeats stop).
+    Peers abort via the scheduler watchdog, every device frees, the job
+    requeues and completes on the survivors, and a concurrent single-worker
+    job stream suffers zero loss."""
+    stack = await make_stack(3, registry_ttl_s=0.6, rendezvous_timeout_s=2.0)
+    try:
+        # a separate single-job lane on its own pool/worker
+        single = Worker(bus=stack.bus, store=stack.store, worker_id="solo",
+                        pool="single", heartbeat_interval_s=0.2)
+
+        async def echo(ctx):
+            return {"ok": True}
+
+        single.register_default(echo)
+        await single.start()
+
+        await submit_gang(stack, "g-crash",
+                          {"op": "gang_test", "spin_s": 2.0}, workers=2)
+        await asyncio.sleep(0.4)
+        rec = stack.gangs._by_job["g-crash"]
+        assert rec.state == "RUNNING"
+        victim = next(w for w in stack.workers
+                      if w.worker_id == rec.members[0])
+        # concurrent single-worker stream, spanning the crash window
+        singles = [f"s-{i}" for i in range(12)]
+
+        async def stream_singles():
+            for jid in singles:
+                await stack.bus.publish(subj.SUBMIT, BusPacket.wrap(
+                    JobRequest(job_id=jid, topic="job.single",
+                               tenant_id="default"),
+                    sender_id="test"))
+                await asyncio.sleep(0.05)
+
+        stream = asyncio.ensure_future(stream_singles())
+        # SIGKILL-equivalent: tear the worker down abruptly — its member
+        # task dies silently, its heartbeats stop
+        await victim.stop()
+        assert await wait_state(stack.js, "g-crash", timeout_s=30.0) == "SUCCEEDED"
+        res = await stack.store.get_result("g-crash")
+        assert victim.worker_id not in res["workers"]
+        await stream
+        for jid in singles:
+            assert await wait_state(stack.js, jid, timeout_s=20.0) == "SUCCEEDED"
+        assert stack.gangs.ledger.reserved_workers == {}
+        assert stack.gangs.ledger.verify() == 0
+        assert stack.eng.metrics.gang_partial_reservations.total() == 0
+        assert stack.eng.metrics.gang_aborts.value(reason="worker_dead") >= 1
+        await single.stop()
+    finally:
+        await teardown(stack)
+
+
+async def test_gang_spans_cover_reserve_rendezvous_step_release():
+    stack = await make_stack(2)
+    try:
+        spans: list = []
+
+        async def collect(subject, pkt):
+            spans.append(pkt.payload)
+
+        await stack.bus.subscribe(subj.TRACE_SPAN, collect)
+        req = await submit_gang(stack, "g-span", {"op": "gang_echo"}, workers=2)
+        assert await wait_state(stack.js, "g-span") == "SUCCEEDED"
+        for _ in range(20):
+            await stack.bus.drain()
+            await asyncio.sleep(0.01)
+        names = {sp.name for sp in spans}
+        assert {"gang-reserve", "gang-dispatch", "gang-rendezvous",
+                "gang-step", "gang-release"} <= names
+        # all on the job's trace (one waterfall)
+        trace_ids = {sp.trace_id for sp in spans
+                     if sp.name.startswith("gang-")}
+        assert len(trace_ids) == 1
+    finally:
+        await teardown(stack)
+
+
+# ---------------------------------------------------------------------------
+# MPMD pipeline numerics: distributed == monolithic
+# ---------------------------------------------------------------------------
+
+
+def test_mpmd_stage_grads_match_monolithic_reference():
+    """The stage-per-worker forward/backward chain (activations + cotangents
+    as they would cross the wire) reproduces the monolithic model's loss and
+    gradients exactly."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cordum_tpu.models import llama, pipeline
+    from cordum_tpu.models.llama import rms_norm
+    from cordum_tpu.models.pipeline import _stage_apply
+    from cordum_tpu.worker.gang import _mpmd_backward, _mpmd_build, _mpmd_forward
+
+    payload = {"seed": 0}
+    s0 = _mpmd_build(payload, 0, 2)
+    s1 = _mpmd_build(payload, 1, 2)
+    base = s0["base"]
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(7), (2, 12), 0, base.vocab_size))
+
+    # distributed: rank0 forward → serialize → rank1 loss/grad → cotangent
+    # back through rank0 (round-trip through the wire encoding)
+    y0, vjp0 = _mpmd_forward(s0, tokens, None)
+    wire = np.frombuffer(y0.tobytes(), np.float32).reshape(y0.shape)
+    loss, g1, gx = _mpmd_forward(s1, tokens, wire)
+    gx_wire = np.frombuffer(
+        np.asarray(gx, np.float32).tobytes(), np.float32).reshape(gx.shape)
+    g0, g_none = _mpmd_backward(vjp0, gx_wire)
+    assert g_none is None
+
+    cfg = pipeline.PipelineConfig(base=base, n_stages=2, n_microbatches=1)
+    full = pipeline.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jnp.asarray(tokens)
+    mb, t = tok.shape
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (mb, t))
+
+    def ref_loss(full):
+        x = full["embed"][tok].astype(jnp.float32)
+        x = _stage_apply(jax.tree.map(lambda p: p[0], full["stages"]), x, pos, base)
+        x = _stage_apply(jax.tree.map(lambda p: p[1], full["stages"]), x, pos, base)
+        h = rms_norm(x, full["final_norm"], base.norm_eps)
+        logits = (h @ full["lm_head"]).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        nll = -jnp.take_along_axis(logp, tok[:, 1:][..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    ref, gref = jax.value_and_grad(ref_loss)(full)
+    assert loss == pytest.approx(float(ref), abs=1e-5)
+    assert np.allclose(np.asarray(g0["embed"]),
+                       np.asarray(gref["embed"]), atol=1e-4)
+    assert np.allclose(np.asarray(g1["lm_head"]),
+                       np.asarray(gref["lm_head"]), atol=1e-4)
+    assert np.allclose(np.asarray(g0["stage"]["wq"]),
+                       np.asarray(gref["stages"]["wq"][0]), atol=1e-4)
+    assert np.allclose(np.asarray(g1["stage"]["wq"]),
+                       np.asarray(gref["stages"]["wq"][1]), atol=1e-4)
+
+
+async def test_gang_mpmd_pipeline_end_to_end():
+    """pp=2, workers=2: stage-per-worker MPMD training runs end-to-end
+    through the scheduled gang pipeline with activations forwarded over the
+    bus; the last stage owns the loss."""
+    stack = await make_stack(2, trainer=True, rendezvous_timeout_s=10.0,
+                             peer_timeout_s=30.0)
+    try:
+        await submit_gang(stack, "g-mpmd", {
+            "op": "train", "model": "pipeline", "steps": 1, "batch": 4,
+            "seq": 12, "microbatches": 2, "mesh": {"dp": -1, "pp": 2},
+            "gang": {"workers": 2},
+        }, workers=2)
+        assert await wait_state(stack.js, "g-mpmd", timeout_s=120.0) == "SUCCEEDED"
+        res = await stack.store.get_result("g-mpmd")
+        assert res["mode"] == "mpmd"
+        assert res["per_rank"]["1"]["loss"] is not None
+        assert res["per_rank"]["0"]["loss"] is None  # stage 0 never sees it
+        assert res["steps_done"] == 1
+        assert res["mesh"]["pp"] == 2
+    finally:
+        await teardown(stack)
+
+
+@pytest.mark.slow
+async def test_gang_spmd_dense_end_to_end():
+    """The dense dp×tp×sp MULTICHIP flow as a scheduled 2-worker SPMD gang
+    (each member runs the identical mesh program; slow tier — compiles a
+    full train step)."""
+    stack = await make_stack(2, trainer=True, rendezvous_timeout_s=15.0)
+    try:
+        await submit_gang(stack, "g-spmd", {
+            "op": "train", "model": "llama-tiny", "steps": 1, "batch": 4,
+            "seq": 16, "mesh": {"tp": 2, "sp": 2},
+            "gang": {"workers": 2},
+        }, workers=2)
+        assert await wait_state(stack.js, "g-spmd", timeout_s=300.0) == "SUCCEEDED"
+        res = await stack.store.get_result("g-spmd")
+        assert res["mode"] == "spmd"
+        assert res["loss"] is not None
+        assert res["per_rank"]["0"]["mesh"]["tp"] == 2
+    finally:
+        await teardown(stack)
+
+
+# ---------------------------------------------------------------------------
+# observability: gangs doc, fleet merge, render, floor gates
+# ---------------------------------------------------------------------------
+
+
+async def test_gangs_doc_flows_to_fleet_and_renders():
+    from cordum_tpu.infra.metrics import Metrics
+    from cordum_tpu.obs import FleetAggregator, TelemetryExporter
+
+    bus = LoopbackBus()
+    agg = FleetAggregator(bus, metrics=Metrics(), fine_step_s=0.5)
+    await agg.start()
+    gang_rows = [{
+        "gang_id": "gg-1", "job_id": "job-1", "state": "RUNNING",
+        "workers": 2, "chips_per_worker": 8, "members": ["w0", "w1"],
+        "ready": 2, "done": 0, "age_s": 1.5, "reason": "",
+    }]
+    exporter = TelemetryExporter(
+        "scheduler", bus, Metrics(), instance_id="sched-0", interval_s=0.5,
+        health_fn=lambda: {"role": "scheduler", "gangs": gang_rows,
+                           "gang_queue_depth": 3},
+    )
+    await exporter.publish_once()
+    await bus.drain()
+    doc = agg.gangs_doc()
+    assert doc["queue_depth"] == 3
+    assert doc["scheduler_shards"] == 1
+    assert doc["gangs"][0]["gang_id"] == "gg-1"
+    assert doc["gangs"][0]["shard"] == "sched-0"
+    table = render_gang_table(doc)
+    assert "gg-1" in table and "w0,w1" in table and "RUNNING" in table
+    assert render_gang_table({"gangs": []}).count("no gangs") == 1
+    await agg.stop()
+    await bus.close()
+
+
+async def test_gang_metrics_reach_fleet_exposition():
+    stack = await make_stack(2)
+    try:
+        from cordum_tpu.obs import FleetAggregator, TelemetryExporter
+
+        agg = FleetAggregator(stack.bus, metrics=stack.eng.metrics,
+                              fine_step_s=0.5)
+        await agg.start()
+        exporter = TelemetryExporter(
+            "scheduler", stack.bus, stack.eng.metrics,
+            instance_id="sched-0", interval_s=0.5,
+            health_fn=lambda: {"role": "scheduler"},
+        )
+        await submit_gang(stack, "g-met", {"op": "gang_echo"}, workers=2)
+        assert await wait_state(stack.js, "g-met") == "SUCCEEDED"
+        await exporter.publish_once()
+        await stack.bus.drain()
+        text = agg.render()
+        assert "cordum_gang_admissions_total" in text
+        assert 'outcome="reserved"' in text
+        assert "cordum_gang_rendezvous_seconds" in text
+        await agg.stop()
+    finally:
+        await teardown(stack)
+
+
+def test_floor_checker_gates_gang_keys(tmp_path):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import check_bench_floor as mod
+    finally:
+        sys.path.pop(0)
+    floors = json.loads((REPO / "bench_floor.json").read_text())
+    base = {"gang_jobs_per_sec": 4.0, "gang_flows_ok": 1.0,
+            "gang_partial_reservations": 0.0}
+    # only gang keys present: every non-gang floor flags missing, but the
+    # gang keys themselves pass/fail on their own values
+    doc = dict(base)
+    assert not any("gang" in v for v in mod.check(doc, floors))
+    doc["gang_partial_reservations"] = 1.0
+    assert any("gang_partial_reservations" in v for v in mod.check(doc, floors))
+    doc["gang_partial_reservations"] = 0.0
+    doc["gang_jobs_per_sec"] = 0.0
+    assert any("gang_jobs_per_sec" in v for v in mod.check(doc, floors))
+    doc["gang_jobs_per_sec"] = 4.0
+    doc["gang_flows_ok"] = 0.0
+    assert any("gang_flows_ok" in v for v in mod.check(doc, floors))
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL a real gang member subprocess mid-step (acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # real statebus + three cmd.worker subprocesses
+async def test_sigkill_gang_member_mid_step_gang_recovers(tmp_path):
+    """SIGKILL a real ``cmd.worker`` subprocess mid-gang-step: the peer
+    aborts (scheduler watchdog sees the silence), every reserved device is
+    released, the job requeues and completes on the survivors, and a
+    concurrent single-worker job stream suffers zero loss."""
+    from cordum_tpu.infra.chaos import ServerProc, WorkerProc, free_port
+    from cordum_tpu.infra.statebus import connect
+
+    from .test_chaos import REPO_ROOT, wait_for
+
+    port = free_port()
+    sb = ServerProc(port, env={"STATEBUS_AOF": str(tmp_path / "s.aof")},
+                    cwd=REPO_ROOT)
+    await sb.start()
+    url = f"statebus://127.0.0.1:{port}"
+    kv, bus, conn = await connect(url)
+    js, ms = JobStore(kv), MemoryStore(kv)
+    kernel = SafetyKernel(policy_doc={
+        "tenants": {"default": {"allow_topics": ["job.*", "job.>"]}}})
+    reg = WorkerRegistry(ttl_s=3.0)
+    pc = parse_pool_config({"topics": {"job.tpu.>": "tpu"},
+                            "pools": {"tpu": {"requires": []}}})
+    eng = Engine(bus=bus, job_store=js, safety=SafetyClient(kernel.check),
+                 strategy=LeastLoadedStrategy(reg, pc), registry=reg)
+    gangs = GangScheduler(eng, pc, rendezvous_timeout_s=8.0,
+                          watch_interval_s=0.25)
+    await eng.start()
+    await gangs.start()
+    wenv = {
+        "CORDUM_STATEBUS_URL": url,
+        "WORKER_POOL": "tpu",
+        "WORKER_TOPICS": "job.tpu.>",
+        "WORKER_CAPABILITIES": "tpu,echo",
+        "WORKER_HEARTBEAT_INTERVAL": "0.5",
+        "WORKER_BATCHING": "0",
+        "WORKER_SERVING": "0",
+        "WORKER_GANG_RENDEZVOUS_TIMEOUT": "8.0",
+    }
+    procs = [
+        WorkerProc(f"gang-w{i}", env=wenv, cwd=REPO_ROOT,
+                   log_path=str(tmp_path / f"w{i}.log"))
+        for i in range(3)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        await wait_for(lambda: len(reg.snapshot()) >= 3, 180.0,
+                       "all three workers heartbeating")
+        # the gang spins long enough to span the kill + registry TTL
+        ptr = await ms.put_context("g-chaos", {"op": "gang_test",
+                                               "spin_s": 6.0})
+        await bus.publish(subj.SUBMIT, BusPacket.wrap(
+            JobRequest(job_id="g-chaos", topic="job.tpu.gang",
+                       tenant_id="default", context_ptr=ptr,
+                       labels={LABEL_GANG_WORKERS: "2"}),
+            sender_id="t"))
+        await wait_for(
+            lambda: _rec_running(gangs, "g-chaos"), 60.0, "gang running")
+        rec = gangs._by_job["g-chaos"]
+        victim_id = rec.members[0]
+        victim = next(p for p in procs if p.worker_id == victim_id)
+        await asyncio.sleep(1.0)  # mid-step
+        victim.kill()  # SIGKILL: no drain, no abort, heartbeats just stop
+        # concurrent single-worker stream spanning the recovery window
+        singles = [f"chaos-s-{i}" for i in range(8)]
+        for jid in singles:
+            sptr = await ms.put_context(jid, {"op": "echo", "v": jid})
+            await bus.publish(subj.SUBMIT, BusPacket.wrap(
+                JobRequest(job_id=jid, topic="job.tpu.echo",
+                           tenant_id="default", context_ptr=sptr),
+                sender_id="t"))
+            await asyncio.sleep(0.1)
+        await wait_for(
+            lambda: _get_state_eq(js, "g-chaos", "SUCCEEDED"), 120.0,
+            "gang recovered on survivors")
+        res = await ms.get_result("g-chaos")
+        assert victim_id not in res["workers"]
+        for jid in singles:
+            await wait_for(lambda jid=jid: _get_state_eq(js, jid, "SUCCEEDED"),
+                           60.0, f"single {jid}")
+        assert gangs.ledger.reserved_workers == {}
+        assert gangs.ledger.verify() == 0
+        assert eng.metrics.gang_partial_reservations.total() == 0
+        assert eng.metrics.gang_aborts.value(reason="worker_dead") >= 1
+    finally:
+        for p in procs:
+            p.kill()
+        await gangs.stop()
+        await eng.stop()
+        await conn.close()
+        sb.kill()
+
+
+async def _rec_running(gangs, job_id) -> bool:
+    rec = gangs._by_job.get(job_id)
+    return rec is not None and rec.state == "RUNNING" and bool(rec.members)
+
+
+async def _get_state_eq(js, jid, want) -> bool:
+    return await js.get_state(jid) == want
+
+
+async def test_gang_member_redelivery_republishes_done():
+    """A redelivered member packet after completion republishes the cached
+    done report instead of re-running the step program (worker-level
+    idempotence, gang-shaped)."""
+    stack = await make_stack(2)
+    try:
+        await submit_gang(stack, "g-redo", {"op": "gang_echo"}, workers=2)
+        assert await wait_state(stack.js, "g-redo") == "SUCCEEDED"
+        w0 = stack.workers[0]
+        runner = w0.gang
+        assert "g-redo" in runner._done
+        done_msgs: list = []
+
+        async def tap(subject, pkt):
+            m = pkt.gang_msg
+            if m is not None and m.kind == "done":
+                done_msgs.append(m)
+
+        gid = runner._done["g-redo"].gang_id
+        await stack.bus.subscribe(subj.gang_subject(gid), tap)
+        # re-deliver the member packet (the scheduler nudge path's shape)
+        member_req = JobRequest(
+            job_id="g-redo", topic="job.gang",
+            labels={"cordum.gang_id": gid, "cordum.gang_rank": "0",
+                    "cordum.gang_size": "2"},
+        )
+        await runner.handle(member_req, {"op": "gang_echo"})
+        await stack.bus.drain()
+        assert done_msgs and done_msgs[0].rank == 0
+    finally:
+        await teardown(stack)
