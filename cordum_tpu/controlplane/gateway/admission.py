@@ -421,7 +421,6 @@ class AdmissionController:
                     interactive_burn_5m=round(self._interactive_burn, 3),
                     preempt_batch=self._tier >= 1,
                     reason="slo_pressure" if self._tier >= 1 else "clear",
-                    sender=self.instance_id,
                 ),
                 sender_id=self.instance_id,
             ),

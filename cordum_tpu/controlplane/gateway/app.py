@@ -370,6 +370,7 @@ class Gateway:
         await site.start()
         logx.info("gateway listening", host=host, port=port)
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         for s in self._subs:
             s.unsubscribe()

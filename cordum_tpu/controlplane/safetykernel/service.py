@@ -57,6 +57,7 @@ class KernelService:
         logx.info("safety kernel listening", host=host, port=port,
                   snapshot=self.kernel.snapshot_id)
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         if self._reload_task:
             self._reload_task.cancel()

@@ -31,7 +31,7 @@ import random
 import time
 from typing import AsyncIterator, Optional
 
-from ...infra import codec, logging as logx
+from ...infra import codec, logging as logx, syncsan
 from ...infra.bus import Bus, MAX_NAK_DELAY_S, RetryAfter
 from ...infra.configsvc import ConfigService
 from ...infra.jobstore import JobStore, MetaSnapshot, SafetyDecisionRecord, meta_key
@@ -140,6 +140,7 @@ class _ResultItem:
         return self.res.job_id
 
 
+@syncsan.instrument
 class Engine:
     def __init__(
         self,
@@ -192,7 +193,11 @@ class Engine:
             self.owns = _owns_everything  # type: ignore[method-assign]
             self._stamp_partition = self._stamp_noop  # type: ignore[method-assign]
         self._inflight = 0  # submit backlog gauge (cordum_shard_partition_queue_depth)
-        self._subs = []
+        # start()/stop() hold this across their subscribe/teardown awaits so
+        # a racing start+stop pair cannot interleave at an await and leak a
+        # subscription or a half-cancelled drain task (CL008)
+        self._lifecycle_lock = asyncio.Lock()
+        self._subs = []  # cordum: guarded-by(_lifecycle_lock)
         # tick batching (ISSUE 6): submits arriving in one event-loop tick
         # drain together; grouped commits need co-committable keys, which
         # kv.pipe_group answers per key
@@ -200,7 +205,7 @@ class Engine:
         self._submit_q: list[_SubmitItem] = []
         self._result_q: list[_ResultItem] = []
         self._submit_wake = asyncio.Event()
-        self._drain_task: Optional[asyncio.Task] = None
+        self._drain_task: Optional[asyncio.Task] = None  # cordum: guarded-by(_lifecycle_lock)
         # dispatch-time snapshot cache: the RUNNING commit's post-commit
         # MetaSnapshot, so the result path needs ZERO reads in the common
         # case (a conflict — e.g. a cancel racing the result — re-reads)
@@ -231,64 +236,66 @@ class Engine:
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
-        # plain subjects stay subscribed even when sharded: they are the
-        # unstamped-publisher fallback — whichever shard draws the message
-        # from the queue group forwards it to the owner's partition subject
-        self._subs = [
-            await self.bus.subscribe(subj.SUBMIT, self._on_submit, queue=subj.QUEUE_SCHEDULER),
-            await self.bus.subscribe(subj.RESULT, self._on_result, queue=subj.QUEUE_SCHEDULER),
-            await self.bus.subscribe(subj.CANCEL, self._on_cancel, queue=subj.QUEUE_SCHEDULER),
-            await self.bus.subscribe(subj.HEARTBEAT, self._on_heartbeat),
-            await self.bus.subscribe(subj.PROGRESS, self._on_progress),
-            await self.bus.subscribe(subj.ADMISSION_PRESSURE, self._on_pressure),
-        ]
-        if self.shard_count > 1:
-            # this shard's slice of the keyspace: its own partition subjects
-            # (queue groups so replicas of one shard still split the load)
-            q = f"{subj.QUEUE_SCHEDULER}-{self.shard_index}"
-            self._subs += [
-                await self.bus.subscribe(
-                    subj.submit_subject(self.shard_index, self.shard_count),
-                    self._on_submit, queue=q),
-                await self.bus.subscribe(
-                    subj.result_subject(self.shard_index, self.shard_count),
-                    self._on_result, queue=q),
-                await self.bus.subscribe(
-                    subj.cancel_subject(self.shard_index, self.shard_count),
-                    self._on_cancel, queue=q),
+        async with self._lifecycle_lock:
+            # plain subjects stay subscribed even when sharded: they are the
+            # unstamped-publisher fallback — whichever shard draws the message
+            # from the queue group forwards it to the owner's partition subject
+            self._subs = [
+                await self.bus.subscribe(subj.SUBMIT, self._on_submit, queue=subj.QUEUE_SCHEDULER),
+                await self.bus.subscribe(subj.RESULT, self._on_result, queue=subj.QUEUE_SCHEDULER),
+                await self.bus.subscribe(subj.CANCEL, self._on_cancel, queue=subj.QUEUE_SCHEDULER),
+                await self.bus.subscribe(subj.HEARTBEAT, self._on_heartbeat),
+                await self.bus.subscribe(subj.PROGRESS, self._on_progress),
+                await self.bus.subscribe(subj.ADMISSION_PRESSURE, self._on_pressure),
             ]
-        if self.batch_ticks and self._drain_task is None:
-            self._drain_task = asyncio.ensure_future(self._submit_drain_loop())
+            if self.shard_count > 1:
+                # this shard's slice of the keyspace: its own partition subjects
+                # (queue groups so replicas of one shard still split the load)
+                q = f"{subj.QUEUE_SCHEDULER}-{self.shard_index}"
+                self._subs += [
+                    await self.bus.subscribe(
+                        subj.submit_subject(self.shard_index, self.shard_count),
+                        self._on_submit, queue=q),
+                    await self.bus.subscribe(
+                        subj.result_subject(self.shard_index, self.shard_count),
+                        self._on_result, queue=q),
+                    await self.bus.subscribe(
+                        subj.cancel_subject(self.shard_index, self.shard_count),
+                        self._on_cancel, queue=q),
+                ]
+            if self.batch_ticks and self._drain_task is None:
+                self._drain_task = asyncio.ensure_future(self._submit_drain_loop())
 
     async def stop(self) -> None:
-        for s in self._subs:
-            s.unsubscribe()
-        self._subs = []
-        if self._drain_task is not None:
-            self._drain_task.cancel()
-            try:
-                await self._drain_task
-            except asyncio.CancelledError:
-                pass
-            self._drain_task = None
-        for it in [*self._submit_q, *self._result_q]:
-            if not it.fut.done():
-                it.fut.cancel()
-        self._submit_q = []
-        self._result_q = []
-        self._snap_cache.clear()
-        self._stream_tokens.clear()
-        if self._preempt_scan is not None:
-            self._preempt_scan.cancel()
-            await logx.join_task(self._preempt_scan, name="preempt-scan")
-            self._preempt_scan = None
-        for t in list(self._preempt_tasks):
-            t.cancel()
-            await logx.join_task(t, name="preempt-redispatch")
-        self._preempt_tasks.clear()
-        self._preempt_cooldown.clear()
+        async with self._lifecycle_lock:
+            for s in self._subs:
+                s.unsubscribe()
+            self._subs = []
+            if self._drain_task is not None:
+                self._drain_task.cancel()
+                try:
+                    await self._drain_task
+                except asyncio.CancelledError:
+                    pass
+                self._drain_task = None
+            for it in [*self._submit_q, *self._result_q]:
+                if not it.fut.done():
+                    it.fut.cancel()
+            self._submit_q = []
+            self._result_q = []
+            self._snap_cache.clear()
+            self._stream_tokens.clear()
+            if self._preempt_scan is not None:
+                self._preempt_scan.cancel()
+                await logx.join_task(self._preempt_scan, name="preempt-scan")
+                self._preempt_scan = None
+            for t in list(self._preempt_tasks):
+                t.cancel()
+                await logx.join_task(t, name="preempt-redispatch")
+            self._preempt_tasks.clear()
+            self._preempt_cooldown.clear()
 
-    # ------------------------------------------------------------------
+        # ------------------------------------------------------------------
     def owns(self, job_id: str) -> bool:
         return partition_of(job_id, self.shard_count) == self.shard_index
 

@@ -301,6 +301,7 @@ class GangScheduler:
         if self._watch_task is None:
             self._watch_task = asyncio.ensure_future(self._watch_loop())
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         for s in self._subs:
             s.unsubscribe()

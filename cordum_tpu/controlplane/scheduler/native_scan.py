@@ -16,7 +16,7 @@ from typing import Optional
 
 from ...infra.registry import WorkerRegistry
 from ...native import load_strategy_scan
-from .strategy import _parse_tpu_requires
+from .strategy import HBM_OVERLOAD_FRACTION, _parse_tpu_requires
 
 REBUILD_INTERVAL_S = 1.0  # also time-bounded: TTL-expired workers must drop
                           # from the pack even when no heartbeat mutates the
@@ -97,7 +97,13 @@ class PackedWorkers:
             self._maxp[i] = float(hb.max_parallel_jobs)
             self._cpu[i] = float(hb.cpu_load)
             self._duty[i] = float(hb.tpu_duty_cycle)
-            self._healthy[i] = 1 if hb.devices_healthy else 0
+            # eligibility byte for the C scan: device health AND the HBM
+            # pressure gate (is_overloaded's memory leg — the kernel computes
+            # the load legs from active/cpu/duty itself but never sees HBM)
+            hbm_full = (hb.hbm_total_gb > 0 and
+                        hb.hbm_used_gb / hb.hbm_total_gb
+                        >= HBM_OVERLOAD_FRACTION)
+            self._healthy[i] = 1 if (hb.devices_healthy and not hbm_full) else 0
         self._built_version = self.registry.version
 
     def refresh(self) -> None:

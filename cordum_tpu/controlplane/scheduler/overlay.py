@@ -72,6 +72,7 @@ class ConfigOverlay:
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._loop())
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
@@ -115,6 +116,7 @@ class WorkerSnapshotWriter:
 
         self._task = asyncio.ensure_future(loop())
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()

@@ -202,6 +202,7 @@ class DecodeRebalancer:
         if self._task is None:
             self._task = asyncio.ensure_future(self._loop())
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()

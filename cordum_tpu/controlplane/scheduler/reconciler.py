@@ -49,6 +49,7 @@ class Reconciler:
         self._stop.clear()
         self._task = asyncio.ensure_future(self._loop())
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         self._stop.set()
         if self._task:
@@ -163,6 +164,7 @@ class WorkerFailover:
         self._stop.clear()
         self._task = asyncio.ensure_future(self._loop())
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         self._stop.set()
         if self._task:
@@ -223,6 +225,7 @@ class PendingReplayer:
         self._stop.clear()
         self._task = asyncio.ensure_future(self._loop())
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         self._stop.set()
         if self._task:

@@ -53,6 +53,7 @@ _TOPOLOGY_RE = re.compile(r"^topology:([0-9x]+)$")
 
 OVERLOAD_FRACTION = 0.9
 OVERLOAD_UTIL = 90.0
+HBM_OVERLOAD_FRACTION = 0.95
 BATCH_AFFINITY_TTL_S = 5.0
 # Sessions outlive batch-fill windows: the TTL covers think-time between a
 # conversation's turns, after which its KV pages are presumed reclaimed and
@@ -175,6 +176,11 @@ def is_overloaded(hb: Heartbeat) -> bool:
     if hb.max_parallel_jobs > 0 and hb.active_jobs >= OVERLOAD_FRACTION * hb.max_parallel_jobs:
         return True
     if hb.cpu_load >= OVERLOAD_UTIL or hb.tpu_duty_cycle >= OVERLOAD_UTIL:
+        return True
+    # HBM pressure: a worker whose accelerator memory is effectively full
+    # cannot take another placement even if its MXU duty cycle looks idle
+    # (weights/KV arenas are resident; the next job would OOM, not queue)
+    if hb.hbm_total_gb > 0 and hb.hbm_used_gb / hb.hbm_total_gb >= HBM_OVERLOAD_FRACTION:
         return True
     return False
 

@@ -50,6 +50,7 @@ class WorkflowEngineService:
         self._stop.clear()
         self._task = asyncio.ensure_future(self._reconcile_loop())
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         for s in self._subs:
             s.unsubscribe()

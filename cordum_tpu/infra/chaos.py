@@ -148,6 +148,7 @@ class ChaosProxy:
         logx.info("chaos proxy listening", port=self.port,
                   target=f"{self.target_host}:{self.target_port}")
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()

@@ -283,12 +283,21 @@ class ReplicaLink:
         self._last_seen = time.monotonic()
         self._task = asyncio.ensure_future(self._run())
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         self._stop.set()
-        if self._task is not None and self._task is not asyncio.current_task():
-            self._task.cancel()
-            await logx.join_task(self._task, name="replica-link")
-            self._task = None
+        task, self._task = self._task, None
+        if task is None or task is asyncio.current_task():
+            return
+        # Cancel-until-dead: on 3.10 a cancel landing exactly as wait_for's
+        # inner read completes is swallowed (bpo-42130) and the pump keeps
+        # running — possibly into server.promote(), which needs the very
+        # _role_lock our caller holds while joining us.  Re-cancel until the
+        # task actually finishes so the join below cannot deadlock.
+        while not task.done():
+            task.cancel()
+            await asyncio.wait([task], timeout=0.1)
+        await logx.join_task(task, name="replica-link")
 
     # -- internals ------------------------------------------------------
     def _dead_for(self) -> float:

@@ -55,6 +55,7 @@ from .bus import (
 from .frames import FrameWriter as _FrameWriter, encode_frame as _encode, read_frame as _read_frame
 from .kv import KV, MemoryKV
 from .metrics import Metrics
+from . import syncsan
 from .replication import (
     ReplicaLink,
     ReplicationState,
@@ -95,6 +96,7 @@ def _plain(v: Any) -> Any:
     return v
 
 
+@syncsan.instrument
 class StateBusServer:
     """The server process: KV engine + subscription routing + AOF +
     primary/replica replication (docs/PROTOCOL.md §Replication)."""
@@ -138,7 +140,12 @@ class StateBusServer:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.repl = ReplicationState(self)
-        self._replica_link: Optional[ReplicaLink] = None
+        # promote()/demote()/stop() hold this across their link-teardown
+        # awaits so a role transition racing a shutdown (or an auto-promote
+        # racing an admin demotion) cannot interleave and double-stop or
+        # leak the replica link (CL008)
+        self._role_lock = asyncio.Lock()
+        self._replica_link: Optional[ReplicaLink] = None  # cordum: guarded-by(_role_lock)
         self._hb_task: Optional[asyncio.Task] = None
         self._last_peer_probe = 0.0
         self._telemetry = None  # TelemetryExporter, created at start()
@@ -178,45 +185,46 @@ class StateBusServer:
         await self._telemetry.start()
 
     async def stop(self, *, graceful: bool = True) -> None:
-        if self._telemetry is not None:
-            exporter, self._telemetry = self._telemetry, None
-            await exporter.stop()
-        if self._hb_task is not None:
-            task, self._hb_task = self._hb_task, None
-            task.cancel()
-            await logx.join_task(task, name="statebus-repl-hb")
-        if self._replica_link is not None:
-            await self._replica_link.stop()
-            self._replica_link = None
-        if graceful:
-            # GOAWAY before closing: clients fail over to the next endpoint
-            # immediately instead of waiting out call timeouts; an attached
-            # replica treats it as primary-dead and promotes NOW.  Direct
-            # transport writes (not the coalescer): the transport flushes
-            # buffered bytes before FIN on close.
-            goaway = _encode([0, "goaway"])
+        async with self._role_lock:
+            if self._telemetry is not None:
+                exporter, self._telemetry = self._telemetry, None
+                await exporter.stop()
+            if self._hb_task is not None:
+                task, self._hb_task = self._hb_task, None
+                task.cancel()
+                await logx.join_task(task, name="statebus-repl-hb")
+            if self._replica_link is not None:
+                await self._replica_link.stop()
+                self._replica_link = None
+            if graceful:
+                # GOAWAY before closing: clients fail over to the next endpoint
+                # immediately instead of waiting out call timeouts; an attached
+                # replica treats it as primary-dead and promotes NOW.  Direct
+                # transport writes (not the coalescer): the transport flushes
+                # buffered bytes before FIN on close.
+                goaway = _encode([0, "goaway"])
+                for w in list(self._writers):
+                    try:
+                        w.write(goaway)
+                    except (ConnectionError, OSError, RuntimeError):
+                        pass  # peer already gone
+            if self._server:
+                self._server.close()
+            # Close client writers BEFORE wait_closed: Python 3.12's
+            # Server.wait_closed() waits for connection handlers to finish, and
+            # handlers block reading from clients that never hang up.
             for w in list(self._writers):
-                try:
-                    w.write(goaway)
-                except (ConnectionError, OSError, RuntimeError):
-                    pass  # peer already gone
-        if self._server:
-            self._server.close()
-        # Close client writers BEFORE wait_closed: Python 3.12's
-        # Server.wait_closed() waits for connection handlers to finish, and
-        # handlers block reading from clients that never hang up.
-        for w in list(self._writers):
-            w.close()
-        if self._server:
-            await self._server.wait_closed()
-            self._server = None
-        if self._aof:
-            # SIGTERM-path durability: flush AND fsync before exit so a
-            # graceful shutdown never loses the tail to the page cache
-            self._aof.flush()
-            os.fsync(self._aof.fileno())
-            self._aof.close()
-            self._aof = None
+                w.close()
+            if self._server:
+                await self._server.wait_closed()
+                self._server = None
+            if self._aof:
+                # SIGTERM-path durability: flush AND fsync before exit so a
+                # graceful shutdown never loses the tail to the page cache
+                self._aof.flush()
+                os.fsync(self._aof.fileno())
+                self._aof.close()
+                self._aof = None
 
     async def crash(self) -> None:
         """Fault-injection helper (infra/chaos.py): die like a SIGKILLed
@@ -332,44 +340,46 @@ class StateBusServer:
         on primary-dead).  Bumps + persists the epoch so promotion is
         exclusive: a returning old primary sees the higher epoch and
         demotes itself."""
-        if self.role != "primary":
-            link, self._replica_link = self._replica_link, None
-            self.role = "primary"
-            self.replica_of = ""
-            self.repl.epoch += 1
-            self._persist_epoch()
-            self.metrics.statebus_promotions.inc(reason=reason)
-            logx.info("statebus PROMOTED to primary", host=self.host,
-                      port=self.port, reason=reason, epoch=self.repl.epoch,
-                      offset=self.repl.offset)
-            if link is not None:
-                await link.stop()
-        return {"role": self.role, "epoch": self.repl.epoch,
-                "offset": self.repl.offset}
+        async with self._role_lock:
+            if self.role != "primary":
+                link, self._replica_link = self._replica_link, None
+                self.role = "primary"
+                self.replica_of = ""
+                self.repl.epoch += 1
+                self._persist_epoch()
+                self.metrics.statebus_promotions.inc(reason=reason)
+                logx.info("statebus PROMOTED to primary", host=self.host,
+                          port=self.port, reason=reason, epoch=self.repl.epoch,
+                          offset=self.repl.offset)
+                if link is not None:
+                    await link.stop()
+            return {"role": self.role, "epoch": self.repl.epoch,
+                    "offset": self.repl.offset}
 
     async def demote(self, primary_url: str, *, reason: str = "admin") -> dict:
         """Primary → replica of ``primary_url`` (startup peer probe, or an
         admin demotion).  Ordinary clients get a GOAWAY so they re-walk the
         replica set to the real primary."""
-        if self._replica_link is not None:
-            await self._replica_link.stop()
-            self._replica_link = None
-        self.role = "replica"
-        self.replica_of = primary_url
-        self.repl.fail_waiters()
-        for w in list(self.repl.sessions):
-            self.repl.detach(w)
-        goaway = _encode([0, "goaway"])
-        for w in list(self._writers):
-            try:
-                w.write(goaway)
-            except (ConnectionError, OSError, RuntimeError):
-                pass  # peer already gone
-        await self._start_link(primary_url)
-        logx.info("statebus demoted to replica", primary=primary_url,
-                  reason=reason, epoch=self.repl.epoch)
-        return {"role": self.role, "epoch": self.repl.epoch,
-                "offset": self.repl.offset}
+        async with self._role_lock:
+            if self._replica_link is not None:
+                await self._replica_link.stop()
+                self._replica_link = None
+            self.role = "replica"
+            self.replica_of = primary_url
+            self.repl.fail_waiters()
+            for w in list(self.repl.sessions):
+                self.repl.detach(w)
+            goaway = _encode([0, "goaway"])
+            for w in list(self._writers):
+                try:
+                    w.write(goaway)
+                except (ConnectionError, OSError, RuntimeError):
+                    pass  # peer already gone
+            await self._start_link(primary_url)
+            logx.info("statebus demoted to replica", primary=primary_url,
+                      reason=reason, epoch=self.repl.epoch)
+            return {"role": self.role, "epoch": self.repl.epoch,
+                    "offset": self.repl.offset}
 
     async def adopt_epoch(self, epoch: int) -> None:
         """Replica adopting its primary's epoch at incremental handshake."""

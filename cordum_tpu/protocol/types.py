@@ -230,8 +230,10 @@ class WireModel:
 
 @dataclass
 class ContextHints(WireModel):
+    # max_output_tokens was pruned (CL010): encoded on every packet, never
+    # read anywhere — ``from_dict`` ignores unknown keys so old peers that
+    # still send it decode fine
     max_input_tokens: int = 0
-    max_output_tokens: int = 0
     mode: str = ""  # RAW | CHAT | RAG
 
 
@@ -239,7 +241,9 @@ class ContextHints(WireModel):
 class Budget(WireModel):
     max_tokens: int = 0
     max_cost_usd: float = 0.0
-    deadline_unix_ms: int = 0
+    # set by external submitters (gateway JSON → from_dict); nothing
+    # in-tree constructs it, but the deadline sweeper reads it
+    deadline_unix_ms: int = 0  # cordum: wire-compat -- populated by submitter SDKs
 
 
 @dataclass
@@ -265,7 +269,9 @@ class JobRequest(WireModel):
     adapter_id: str = ""
     labels: dict[str, str] = field(default_factory=dict)
     env: dict[str, str] = field(default_factory=dict)
-    parent_job_id: str = ""
+    # parent_job_id was pruned (CL010): workflow lineage rides
+    # workflow_id/run_id; nothing ever read the field.  Old peers that
+    # still send it decode fine (from_dict ignores unknown keys).
     workflow_id: str = ""
     run_id: str = ""
     metadata: Optional[JobMetadata] = None
@@ -323,7 +329,8 @@ class JobProgress(WireModel):
     percent: float = 0.0
     message: str = ""
     result_ptr: str = ""
-    artifact_ptrs: list[str] = field(default_factory=list)
+    # artifact_ptrs was pruned (CL010): artifacts ride JobResult, the
+    # progress-side list was encoded but never read
     status_hint: str = ""
     worker_id: str = ""
     # llm.generate token stream: the tokens emitted since the last progress
@@ -425,7 +432,8 @@ class AdmissionPressure(WireModel):
     interactive_burn_5m: float = 0.0  # worst interactive 5m burn rate
     preempt_batch: bool = False  # interactive burn >= warn: requeue batch
     reason: str = ""
-    sender: str = ""
+    # sender was pruned (CL010): receivers key on the BusPacket envelope's
+    # sender_id; the duplicate payload field was never read
 
 
 @dataclass
@@ -464,7 +472,9 @@ class GangMsg(WireModel):
 
 @dataclass
 class SystemAlert(WireModel):
-    severity: str = "info"
+    # set from workflow notify steps; gateway event taps forward alerts
+    # verbatim to external sinks, which key on it — no in-tree reader
+    severity: str = "info"  # cordum: wire-compat -- consumed by alert sinks behind the gateway tap
     source: str = ""
     message: str = ""
     labels: dict[str, str] = field(default_factory=dict)
@@ -540,12 +550,16 @@ class Constraints(WireModel):
 
     max_tokens: int = 0
     max_cost_usd: float = 0.0
-    sandbox: str = ""
-    toolchain: str = ""
-    diff_limit: str = ""
-    redaction_level: str = ""
+    # the scheduler forwards the whole Constraints dict verbatim to workers
+    # via env[ENV_POLICY_CONSTRAINTS] (engine._apply_constraints); the
+    # sandbox/toolchain/diff/redaction knobs are enforced by the worker-side
+    # executor, not by any in-tree reader
+    sandbox: str = ""  # cordum: wire-compat -- enforced worker-side via ENV_POLICY_CONSTRAINTS
+    toolchain: str = ""  # cordum: wire-compat -- enforced worker-side via ENV_POLICY_CONSTRAINTS
+    diff_limit: str = ""  # cordum: wire-compat -- enforced worker-side via ENV_POLICY_CONSTRAINTS
+    redaction_level: str = ""  # cordum: wire-compat -- enforced worker-side via ENV_POLICY_CONSTRAINTS
     max_chips: int = 0
-    allowed_topologies: list[str] = field(default_factory=list)
+    allowed_topologies: list[str] = field(default_factory=list)  # cordum: wire-compat -- enforced worker-side via ENV_POLICY_CONSTRAINTS
     env: dict[str, str] = field(default_factory=dict)
 
 
@@ -578,8 +592,10 @@ class PolicyCheckResponse(WireModel):
     reason: str = ""
     rule_id: str = ""
     policy_snapshot: str = ""
-    approval_required: bool = False
-    approval_ref: str = ""
+    # mirrors decision==REQUIRE_APPROVAL as a plain bool so non-Python
+    # admin tooling doesn't need the Decision enum; approval_ref was
+    # pruned (CL010) — never set, never read
+    approval_required: bool = False  # cordum: wire-compat -- read by external admin tooling
     throttle_delay_s: float = 0.0
     constraints: Optional[Constraints] = None
     remediations: list[Remediation] = field(default_factory=list)

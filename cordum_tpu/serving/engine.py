@@ -878,6 +878,7 @@ class ServingEngine:
         return sess.future
 
     # ------------------------------------------------------------------
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         """Evict every session (CANCELLED) and stop the loop — worker
         shutdown; generations are conversation turns, not batch jobs, so

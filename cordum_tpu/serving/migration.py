@@ -101,6 +101,7 @@ class MigrationServer:
             self.port = self._server.sockets[0].getsockname()[1]
         logx.info("migration listener up", addr=self.addr)
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()

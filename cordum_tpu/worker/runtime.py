@@ -310,6 +310,7 @@ class Worker:
         self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
         await self.send_heartbeat()
 
+    # cordum: single-flight -- sole caller is the owning runner's shutdown path; the cancel/await/None teardown is idempotent
     async def stop(self) -> None:
         if self._hb_task:
             self._hb_task.cancel()

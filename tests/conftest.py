@@ -38,6 +38,23 @@ def pytest_pyfunc_call(pyfuncitem):
     return None
 
 
+@pytest.fixture(autouse=True)
+def _syncsan_zero_reports():
+    """CORDUM_SYNC_SANITIZER=1 runs: any interleave race the sanitizer
+    diagnosed during a test fails that test (CI runs tier-1 under the
+    sanitizer as its own step).  Free when the sanitizer is off."""
+    from cordum_tpu.infra import syncsan
+
+    if syncsan.enabled():
+        syncsan.reset()
+    yield
+    if syncsan.enabled():
+        reps = syncsan.reports()
+        syncsan.reset()
+        assert not reps, "sync sanitizer diagnosed interleave races:\n" + \
+            "\n".join(str(r) for r in reps)
+
+
 @pytest.fixture
 def kv():
     from cordum_tpu.infra.kv import MemoryKV

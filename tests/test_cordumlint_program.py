@@ -1,0 +1,463 @@
+"""Whole-program cordumlint rules (CL008-CL011): each fires on a bad
+multi-file fixture tree, stays quiet on the fixed tree, and verifies —
+rather than trusts — its annotations."""
+from __future__ import annotations
+
+from pathlib import Path
+
+from tools.cordumlint.cli import main as cli_main
+from tools.cordumlint.core import lint_paths
+
+
+def run_tree(tmp_path: Path, files: dict[str, str], select=None):
+    """Write a fixture tree (py sources + docs) and lint the py files."""
+    for name, src in files.items():
+        f = tmp_path / name
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(src)
+    paths = [n for n in files if n.endswith(".py")]
+    return lint_paths(paths, root=tmp_path, select=select).findings
+
+
+def messages(findings):
+    return [f.message for f in findings]
+
+
+# ---------------------------------------------------------------- CL008
+
+CL008_RMW = """\
+import asyncio
+
+class Cache:
+    def __init__(self):
+        self.items = []
+
+    async def add(self, fetch, x):
+        cur = self.items
+        data = await fetch(x)
+        self.items = cur + [data]
+"""
+
+CL008_RMW_LOCKED = """\
+import asyncio
+
+class Cache:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self.items = []
+
+    async def add(self, fetch, x):
+        async with self._lock:
+            cur = self.items
+            data = await fetch(x)
+            self.items = cur + [data]
+"""
+
+CL008_CHECK_THEN_ACT = """\
+import asyncio
+
+class Runner:
+    def __init__(self):
+        self._task = None
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.sleep(0)
+            self._task = None
+"""
+
+CL008_SINGLE_FLIGHT = """\
+import asyncio
+
+class Runner:
+    def __init__(self):
+        self._task = None
+
+    # cordum: single-flight -- one shutdown caller by construction
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.sleep(0)
+            self._task = None
+"""
+
+CL008_GUARDED_OK = """\
+import asyncio
+
+class Counter:
+    def __init__(self):
+        self._mu = asyncio.Lock()
+        self.n = 0
+
+    # cordum: guarded-by(_mu) -- caller serializes via self._mu
+    async def bump(self, fetch):
+        cur = self.n
+        await fetch()
+        self.n = cur + 1
+"""
+
+CL008_GUARDED_BOGUS = """\
+import asyncio
+
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    # cordum: guarded-by(_no_such_lock)
+    async def bump(self, fetch):
+        cur = self.n
+        await fetch()
+        self.n = cur + 1
+"""
+
+
+def test_cl008_fires_on_read_modify_write_across_await(tmp_path):
+    findings = run_tree(tmp_path, {"a.py": CL008_RMW}, select={"CL008"})
+    assert len(findings) == 1
+    assert "read-modify-write race: self.items" in findings[0].message
+    assert findings[0].line == 10  # the write-back line
+
+
+def test_cl008_quiet_when_lock_held_across_rmw(tmp_path):
+    assert run_tree(tmp_path, {"a.py": CL008_RMW_LOCKED}, select={"CL008"}) == []
+
+
+def test_cl008_fires_on_check_then_act(tmp_path):
+    findings = run_tree(tmp_path, {"a.py": CL008_CHECK_THEN_ACT}, select={"CL008"})
+    assert len(findings) == 1
+    assert "check-then-act race: self._task" in findings[0].message
+
+
+def test_cl008_single_flight_annotation_waives(tmp_path):
+    assert run_tree(tmp_path, {"a.py": CL008_SINGLE_FLIGHT}, select={"CL008"}) == []
+
+
+def test_cl008_guarded_by_verified_against_class_locks(tmp_path):
+    # a real lock attribute: waived, and the annotation itself is accepted
+    assert run_tree(tmp_path, {"a.py": CL008_GUARDED_OK}, select={"CL008"}) == []
+
+
+def test_cl008_guarded_by_bogus_lock_is_itself_a_finding(tmp_path):
+    findings = run_tree(tmp_path, {"a.py": CL008_GUARDED_BOGUS}, select={"CL008"})
+    assert len(findings) == 1
+    assert "annotation error" in findings[0].message
+    assert "_no_such_lock" in findings[0].message
+
+
+def test_cl008_inline_suppression_still_works(tmp_path):
+    src = CL008_RMW.replace(
+        "        self.items = cur + [data]",
+        "        self.items = cur + [data]  # cordumlint: disable=CL008 -- test",
+    )
+    assert run_tree(tmp_path, {"a.py": src}, select={"CL008"}) == []
+
+
+# ---------------------------------------------------------------- CL009
+
+SUBJECTS_PY = """\
+SUBMIT = "sys.job.submit"
+RESULT = "sys.job.result"
+EVENTS = "sys.events"
+"""
+
+DOC_OK = """\
+# Protocol
+
+## Subjects
+
+| Subject | Delivery | Purpose |
+|---|---|---|
+| `sys.events` | best-effort | fan-out |
+"""
+
+PUB_PY = """\
+from proto import subjects as subj
+
+async def run(bus, pkt):
+    await bus.publish(subj.EVENTS, pkt)
+"""
+
+SUB_PY = """\
+from proto import subjects as subj
+
+async def attach(bus, handler):
+    await bus.subscribe(subj.EVENTS, handler)
+"""
+
+
+def test_cl009_orphan_publish(tmp_path):
+    findings = run_tree(tmp_path, {
+        "proto/protocol/subjects.py": SUBJECTS_PY,
+        "pub.py": PUB_PY,
+        "docs/PROTOCOL.md": DOC_OK,
+    }, select={"CL009"})
+    assert len(findings) == 1
+    assert "orphan publish" in findings[0].message
+    assert "sys.events" in findings[0].message
+    assert findings[0].path == "pub.py"
+
+
+def test_cl009_quiet_when_graph_closes(tmp_path):
+    assert run_tree(tmp_path, {
+        "proto/protocol/subjects.py": SUBJECTS_PY,
+        "pub.py": PUB_PY,
+        "sub.py": SUB_PY,
+        "docs/PROTOCOL.md": DOC_OK,
+    }, select={"CL009"}) == []
+
+
+def test_cl009_external_doc_row_exempts_publish(tmp_path):
+    doc = DOC_OK.replace("fan-out", "external dashboards consume this")
+    assert run_tree(tmp_path, {
+        "proto/protocol/subjects.py": SUBJECTS_PY,
+        "pub.py": PUB_PY,
+        "docs/PROTOCOL.md": doc,
+    }, select={"CL009"}) == []
+
+
+def test_cl009_orphan_subscription(tmp_path):
+    findings = run_tree(tmp_path, {
+        "proto/protocol/subjects.py": SUBJECTS_PY,
+        "sub.py": SUB_PY,
+        "docs/PROTOCOL.md": DOC_OK,
+    }, select={"CL009"})
+    assert len(findings) == 1
+    assert "orphan subscription" in findings[0].message
+
+
+def test_cl009_doc_drift_missing_row(tmp_path):
+    doc = "# Protocol\n\n## Subjects\n\n| Subject | Delivery | Purpose |\n|---|---|---|\n"
+    findings = run_tree(tmp_path, {
+        "proto/protocol/subjects.py": SUBJECTS_PY,
+        "pub.py": PUB_PY,
+        "sub.py": SUB_PY,
+        "docs/PROTOCOL.md": doc,
+    }, select={"CL009"})
+    assert len(findings) == 1
+    assert "doc drift" in findings[0].message
+    assert "no row" in findings[0].message
+
+
+def test_cl009_durability_drift_against_mirror(tmp_path):
+    doc = DOC_OK.replace("best-effort", "durable")
+    findings = run_tree(tmp_path, {
+        "proto/protocol/subjects.py": SUBJECTS_PY,
+        "pub.py": PUB_PY,
+        "sub.py": SUB_PY,
+        "docs/PROTOCOL.md": doc,
+    }, select={"CL009"})
+    assert len(findings) == 1
+    assert "durability drift" in findings[0].message
+    assert findings[0].path == "docs/PROTOCOL.md"
+
+
+# ---------------------------------------------------------------- CL010
+
+TYPES_PY = """\
+from dataclasses import dataclass
+
+@dataclass
+class Thing:
+    used: str = ""
+    dead: str = ""
+"""
+
+TYPES_COMPAT_PY = """\
+from dataclasses import dataclass
+
+@dataclass
+class Thing:
+    used: str = ""
+    dead: str = ""  # cordum: wire-compat -- legacy peers still decode it
+"""
+
+USAGE_PY = """\
+from proto.protocol.types import Thing
+
+def read(t):
+    return t.used
+
+def make():
+    return Thing(used="x")
+"""
+
+
+def test_cl010_dead_field_fires(tmp_path):
+    findings = run_tree(tmp_path, {
+        "proto/protocol/types.py": TYPES_PY,
+        "usage.py": USAGE_PY,
+    }, select={"CL010"})
+    assert len(findings) == 1
+    assert "dead wire field: Thing.dead" in findings[0].message
+    assert findings[0].path == "proto/protocol/types.py"
+
+
+def test_cl010_wire_compat_annotation_exempts(tmp_path):
+    assert run_tree(tmp_path, {
+        "proto/protocol/types.py": TYPES_COMPAT_PY,
+        "usage.py": USAGE_PY,
+    }, select={"CL010"}) == []
+
+
+def test_cl010_never_set_field_fires(tmp_path):
+    usage = USAGE_PY + "\ndef read2(t):\n    return t.dead\n"
+    # `dead` is now read but still never stored anywhere
+    findings = run_tree(tmp_path, {
+        "proto/protocol/types.py": TYPES_PY,
+        "usage.py": usage,
+    }, select={"CL010"})
+    assert len(findings) == 1
+    assert "never-set wire field: Thing.dead" in findings[0].message
+
+
+def test_cl010_positional_ctor_counts_as_store(tmp_path):
+    usage = """\
+from proto.protocol.types import Thing
+
+def read(t):
+    return (t.used, t.dead)
+
+def make():
+    return Thing("x", "y")
+"""
+    assert run_tree(tmp_path, {
+        "proto/protocol/types.py": TYPES_PY,
+        "usage.py": usage,
+    }, select={"CL010"}) == []
+
+
+def test_cl010_record_key_drift(tmp_path):
+    src = """\
+from codec import pack_record, unpack_record
+
+def write(stream):
+    stream.append(pack_record({"offset": 1, "op": "set"}))
+
+def read(blob):
+    rec = unpack_record(blob)
+    return rec["epoch"]
+"""
+    findings = run_tree(tmp_path, {"repl.py": src}, select={"CL010"})
+    assert len(findings) == 1
+    assert "record-key drift" in findings[0].message
+    assert "'epoch'" in findings[0].message
+
+
+def test_cl010_opaque_pack_disables_record_check(tmp_path):
+    src = """\
+from codec import pack_record, unpack_record
+
+def write(stream, payload):
+    stream.append(pack_record(payload))
+
+def read(blob):
+    rec = unpack_record(blob)
+    return rec["epoch"]
+"""
+    assert run_tree(tmp_path, {"repl.py": src}, select={"CL010"}) == []
+
+
+# ---------------------------------------------------------------- CL011
+
+METRICS_DRIFT_PY = """\
+from metrics import Counter
+
+jobs = Counter("cordum_jobs_total", "jobs processed")
+
+def f():
+    jobs.inc(tenant="a")
+
+def g():
+    jobs.inc(pool="b")
+"""
+
+METRICS_OK_PY = """\
+from metrics import Counter
+
+jobs = Counter("cordum_jobs_total", "jobs processed")
+
+def f():
+    jobs.inc(tenant="a")
+
+def g():
+    jobs.inc(tenant="b")
+"""
+
+OBS_DOC = "# Observability\n\n`cordum_jobs_total` counts jobs.\n"
+
+
+def test_cl011_label_schema_drift(tmp_path):
+    findings = run_tree(tmp_path, {
+        "m.py": METRICS_DRIFT_PY,
+        "docs/OBSERVABILITY.md": OBS_DOC,
+    }, select={"CL011"})
+    assert len(findings) == 1
+    assert "label-schema drift: cordum_jobs_total" in findings[0].message
+
+
+def test_cl011_quiet_on_consistent_schema(tmp_path):
+    assert run_tree(tmp_path, {
+        "m.py": METRICS_OK_PY,
+        "docs/OBSERVABILITY.md": OBS_DOC,
+    }, select={"CL011"}) == []
+
+
+def test_cl011_undocumented_metric(tmp_path):
+    findings = run_tree(tmp_path, {
+        "m.py": METRICS_OK_PY,
+        "docs/OBSERVABILITY.md": "# Observability\n\nnothing here\n",
+    }, select={"CL011"})
+    assert len(findings) == 1
+    assert "undocumented metric: cordum_jobs_total" in findings[0].message
+
+
+def test_cl011_inventory_label_drift(tmp_path):
+    doc = (
+        "# Observability\n\n"
+        "<!-- cordumlint: metrics-inventory begin -->\n"
+        "| Metric | Type | Labels | Help |\n"
+        "|---|---|---|---|\n"
+        "| `cordum_jobs_total` | counter | pool | jobs processed |\n"
+        "<!-- cordumlint: metrics-inventory end -->\n"
+    )
+    findings = run_tree(tmp_path, {
+        "m.py": METRICS_OK_PY,
+        "docs/OBSERVABILITY.md": doc,
+    }, select={"CL011"})
+    assert len(findings) == 1
+    assert "inventory drift" in findings[0].message
+    assert "tenant" in findings[0].message
+
+
+def test_cl011_stale_inventory_row(tmp_path):
+    doc = (
+        "# Observability\n\n`cordum_jobs_total` counts jobs.\n\n"
+        "<!-- cordumlint: metrics-inventory begin -->\n"
+        "| Metric | Type | Labels | Help |\n"
+        "|---|---|---|---|\n"
+        "| `cordum_jobs_total` | counter | tenant | jobs processed |\n"
+        "| `cordum_gone_total` | counter | — | removed long ago |\n"
+        "<!-- cordumlint: metrics-inventory end -->\n"
+    )
+    findings = run_tree(tmp_path, {
+        "m.py": METRICS_OK_PY,
+        "docs/OBSERVABILITY.md": doc,
+    }, select={"CL011"})
+    assert len(findings) == 1
+    assert "no longer defines" in findings[0].message
+    assert "cordum_gone_total" in findings[0].message
+
+
+# ------------------------------------------------------- CLI integration
+
+def test_cli_exits_one_on_injected_violation(tmp_path):
+    (tmp_path / "bad.py").write_text(CL008_RMW)
+    rc = cli_main(["bad.py", "--root", str(tmp_path), "--no-baseline"])
+    assert rc == 1
+
+
+def test_cli_exits_zero_on_clean_fixture(tmp_path):
+    (tmp_path / "ok.py").write_text(CL008_RMW_LOCKED)
+    rc = cli_main(["ok.py", "--root", str(tmp_path), "--no-baseline"])
+    assert rc == 0

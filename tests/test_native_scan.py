@@ -35,6 +35,8 @@ def random_registry(n, seed=0):
             cpu_load=rng.uniform(0, 100),
             tpu_duty_cycle=rng.uniform(0, 100),
             devices_healthy=rng.random() > 0.05,
+            hbm_total_gb=rng.choice([0.0, 16.0]),
+            hbm_used_gb=rng.uniform(0, 16.0),
         ))
     return reg
 
@@ -65,6 +67,24 @@ def test_native_matches_python_across_registry_mutations():
     assert native.pick_subject(req) == python.pick_subject(req)
     reg.remove("w00001")
     assert native.pick_subject(req) == python.pick_subject(req)
+
+
+def test_native_skips_hbm_full_worker():
+    """The HBM pressure gate (is_overloaded's memory leg) must hold on the
+    native path too: the C kernel computes the load legs itself but only
+    sees HBM through the packed eligibility byte."""
+    reg = WorkerRegistry()
+    reg.update(Heartbeat(worker_id="w_full", pool="tpu", capabilities=["tpu"],
+                         max_parallel_jobs=10,
+                         hbm_used_gb=15.8, hbm_total_gb=16.0))
+    reg.update(Heartbeat(worker_id="w_ok", pool="tpu", capabilities=["tpu"],
+                         max_parallel_jobs=10, active_jobs=5,
+                         hbm_used_gb=1.0, hbm_total_gb=16.0))
+    assert is_overloaded(reg.get("w_full"))
+    strat = LeastLoadedStrategy(reg, parse_pool_config(POOL_DOC), native=True)
+    req = JobRequest(job_id="j", topic="job.tpu.work")
+    # w_full is idle but memory-saturated; the busier w_ok must win
+    assert strat.pick_subject(req) == "worker.w_ok.jobs"
 
 
 def test_native_no_eligible_falls_to_topic():

@@ -92,3 +92,35 @@ def test_glob_match():
     assert glob_match("job.>", "job.a.b")
     assert glob_match("deploy-*", "deploy-prod")
     assert glob_match("*", "anything.at.all")
+
+
+def test_pruned_wire_fields_tolerate_legacy_peers():
+    """CL010 prunes (parent_job_id, artifact_ptrs, sender, approval_ref,
+    max_output_tokens) must stay read-compatible: a packet from an old peer
+    that still encodes them decodes cleanly, and what we emit round-trips."""
+    legacy = {
+        "job_id": "j-legacy",
+        "topic": "llm.generate",
+        "parent_job_id": "j-parent",  # pruned field, still on old wires
+        "labels": {"k": "v"},
+        "context_hints": {"max_input_tokens": 8, "max_output_tokens": 9,
+                          "mode": "CHAT"},
+    }
+    req = JobRequest.from_dict(legacy)
+    assert req.job_id == "j-legacy"
+    assert req.context_hints is not None
+    assert req.context_hints.max_input_tokens == 8
+    assert not hasattr(req, "parent_job_id")
+    assert not hasattr(req.context_hints, "max_output_tokens")
+    # what we emit round-trips through the wire codec unchanged
+    again = JobRequest.from_wire(req.to_wire())
+    assert again == req
+
+    resp = PolicyCheckResponse.from_dict({
+        "decision": "require_approval",
+        "approval_required": True,
+        "approval_ref": "tick-123",  # pruned
+    })
+    assert resp.approval_required is True
+    assert not hasattr(resp, "approval_ref")
+    assert PolicyCheckResponse.from_wire(resp.to_wire()) == resp

@@ -1,0 +1,131 @@
+"""Runtime sync sanitizer (CORDUM_SYNC_SANITIZER=1): detects the interleave
+races CL008 flags statically — a seeded lost update is reported, the locked
+fix is silent, and instrumentation is a strict no-op when disabled."""
+from __future__ import annotations
+
+import asyncio
+
+from cordum_tpu.infra import syncsan
+
+
+class Racy:
+    """Fixture with the exact annotation grammar syncsan instruments."""
+
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self.counter = 0  # cordum: guarded-by(_lock)
+
+    async def bump_unlocked(self):
+        cur = self.counter
+        await asyncio.sleep(0)
+        self.counter = cur + 1
+
+    async def bump_locked(self):
+        async with self._lock:
+            cur = self.counter
+            await asyncio.sleep(0)
+            self.counter = cur + 1
+
+
+class Plain:
+    def __init__(self):
+        self.counter = 0
+
+
+def test_guarded_attrs_parses_annotation_grammar():
+    assert syncsan.guarded_attrs(Racy) == {"counter": "_lock"}
+    assert syncsan.guarded_attrs(Plain) == {}
+
+
+def test_instrument_is_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv(syncsan.ENV_VAR, raising=False)
+
+    class Off:
+        def __init__(self):
+            self._lock = asyncio.Lock()
+            self.x = 0  # cordum: guarded-by(_lock)
+
+    cls = syncsan.instrument(Off)
+    assert cls is Off
+    assert "x" not in Off.__dict__  # no descriptor installed
+    obj = Off()
+    assert isinstance(obj._lock, asyncio.Lock)  # not wrapped either
+
+
+def _instrumented(monkeypatch):
+    monkeypatch.setenv(syncsan.ENV_VAR, "1")
+    cls = syncsan.instrument(Racy)  # idempotent: descriptors re-installed
+    assert cls is Racy
+    return Racy
+
+
+async def test_detects_seeded_lost_update(monkeypatch):
+    cls = _instrumented(monkeypatch)
+    obj = cls()
+    syncsan.reset()
+    await asyncio.gather(obj.bump_unlocked(), obj.bump_unlocked())
+    reps = syncsan.reports()
+    syncsan.reset()
+    assert any(r.kind == "lost-update" for r in reps), reps
+    rep = next(r for r in reps if r.kind == "lost-update")
+    assert rep.cls == "Racy" and rep.attr == "counter" and rep.lock == "_lock"
+    # and the race really did lose an update
+    assert obj.counter == 1
+
+
+async def test_locked_fix_is_silent(monkeypatch):
+    cls = _instrumented(monkeypatch)
+    obj = cls()
+    syncsan.reset()
+    await asyncio.gather(obj.bump_locked(), obj.bump_locked())
+    reps = syncsan.reports()
+    syncsan.reset()
+    assert reps == []
+    assert obj.counter == 2
+
+
+async def test_lock_is_wrapped_for_ownership(monkeypatch):
+    cls = _instrumented(monkeypatch)
+    obj = cls()
+    syncsan.reset()
+    assert isinstance(obj._lock, syncsan.TrackedLock)
+    assert not obj._lock.held_by_current()
+    async with obj._lock:
+        assert obj._lock.held_by_current()
+    assert not obj._lock.held_by_current()
+    syncsan.reset()
+
+
+async def test_reports_write_under_foreign_lock(monkeypatch):
+    cls = _instrumented(monkeypatch)
+    obj = cls()
+    syncsan.reset()
+    entered = asyncio.Event()
+    release = asyncio.Event()
+
+    async def holder():
+        async with obj._lock:
+            entered.set()
+            await release.wait()
+
+    async def intruder():
+        await entered.wait()
+        obj.counter = 99  # unlocked write while holder owns the lock
+        release.set()
+
+    await asyncio.gather(holder(), intruder())
+    reps = syncsan.reports()
+    syncsan.reset()
+    assert any(r.kind == "write-under-foreign-lock" for r in reps), reps
+
+
+async def test_single_task_rmw_is_silent(monkeypatch):
+    cls = _instrumented(monkeypatch)
+    obj = cls()
+    syncsan.reset()
+    for _ in range(5):
+        await obj.bump_unlocked()  # sequential: no interleave, no report
+    reps = syncsan.reports()
+    syncsan.reset()
+    assert reps == []
+    assert obj.counter == 5

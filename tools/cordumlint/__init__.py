@@ -15,6 +15,6 @@ from __future__ import annotations
 
 from .core import Finding, LintContext, Rule, all_rules, lint_paths
 
-__version__ = "1.0.0"
+__version__ = "2.0.0"
 
 __all__ = ["Finding", "LintContext", "Rule", "all_rules", "lint_paths", "__version__"]

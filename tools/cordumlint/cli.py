@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--show-baselined", action="store_true",
                    help="include baselined findings in the report")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--write-obs-inventory", action="store_true",
+                   help="regenerate the metric inventory section in "
+                        "docs/OBSERVABILITY.md from the code (CL011 checks "
+                        "against it)")
     p.add_argument("--version", action="version", version=f"cordumlint {__version__}")
     return p
 
@@ -56,6 +60,34 @@ def _load_config(root: Path, arg: str | None) -> dict:
     if arg:  # explicitly requested but missing
         raise FileNotFoundError(f"config not found: {path}")
     return {}
+
+
+def _write_obs_inventory(args, root: Path, config: dict) -> int:
+    """Regenerate the CL011-checked metric inventory in docs/OBSERVABILITY.md
+    from the same static collection the rule runs."""
+    from .core import LintContext, _rel, collect_files
+    from .program_rules import (
+        INVENTORY_BEGIN, INVENTORY_END, MetricsConformance, render_inventory,
+    )
+
+    rule = MetricsConformance((config.get("rules", {}) or {}).get("CL011", {}))
+    for f in collect_files(args.paths, root, config.get("exclude", ())):
+        try:
+            rule.collect(LintContext(f, _rel(f, root), f.read_text(encoding="utf-8")))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+    doc = root / rule.doc_rel
+    section = render_inventory(rule)
+    text = doc.read_text(encoding="utf-8") if doc.exists() else ""
+    if INVENTORY_BEGIN in text and INVENTORY_END in text:
+        head, rest = text.split(INVENTORY_BEGIN, 1)
+        tail = rest.split(INVENTORY_END, 1)[1]
+        text = head + section + tail
+    else:
+        text = text.rstrip() + "\n\n## Metric inventory\n\n" + section + "\n"
+    doc.write_text(text, encoding="utf-8")
+    print(f"cordumlint: wrote {len(rule.defs)} metric families -> {doc}")
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -73,6 +105,9 @@ def main(argv: list[str] | None = None) -> int:
             doc = (rule.__doc__ or "").strip().replace("\n    ", "\n  ")
             print(f"{rule.id} {rule.name}\n  {doc}\n")
         return 0
+
+    if args.write_obs_inventory:
+        return _write_obs_inventory(args, root, config)
 
     select = {s.strip().upper() for s in args.select.split(",") if s.strip()}
     ignore = {s.strip().upper() for s in args.ignore.split(",") if s.strip()}

@@ -158,6 +158,50 @@ class Rule:
         )
 
 
+class ProgramRule(Rule):
+    """Whole-program rule: sees every file before judging any of them.
+
+    The engine calls :meth:`collect` once per file (in every file, even
+    allow-listed ones — the *graph* must be complete; ``allow_paths`` only
+    mutes findings reported *in* a path) and then :meth:`finalize` once,
+    after the walk, with the repo root and the ``rel_path -> LintContext``
+    map so finalize-time findings still honor inline suppressions and can
+    carry source snippets.  Findings may point at non-Python files (docs);
+    those have no context and cannot be inline-suppressed — fix the doc.
+    """
+
+    def collect(self, ctx: LintContext) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def finalize(
+        self, root: Path, contexts: dict[str, "LintContext"]
+    ) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def check(self, ctx: LintContext) -> Iterator[Finding]:
+        return iter(())
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        self.collect(ctx)
+        return iter(())
+
+    # -- helpers shared by program rules --------------------------------
+    def finding_at(
+        self,
+        path: str,
+        line: int,
+        message: str,
+        contexts: dict[str, "LintContext"],
+        col: int = 0,
+    ) -> Finding:
+        ctx = contexts.get(path)
+        snippet = ctx.line_text(line).strip() if ctx else ""
+        return Finding(
+            rule_id=self.id, path=path, line=line, col=col,
+            message=message, snippet=snippet,
+        )
+
+
 def all_rules(config: Optional[dict] = None) -> list[Rule]:
     """Instantiate every registered rule honoring per-rule config
     (``{"rules": {"CL001": {"enabled": false, ...}}}``)."""
@@ -230,6 +274,7 @@ def lint_paths(
         rules = [r for r in rules if r.id not in ignore]
     findings: list[Finding] = []
     parse_errors: list[str] = []
+    contexts: dict[str, LintContext] = {}
     files = collect_files(paths, root, config.get("exclude", ()))
     for f in files:
         rel = _rel(f, root)
@@ -239,7 +284,18 @@ def lint_paths(
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             parse_errors.append(f"{rel}: {type(e).__name__}: {e}")
             continue
+        contexts[rel] = ctx
         for rule in rules:
             findings.extend(rule.run(ctx))
+    for rule in rules:
+        if not isinstance(rule, ProgramRule):
+            continue
+        for fi in rule.finalize(root, contexts):
+            if rule.path_allowed(fi.path):
+                continue
+            fctx = contexts.get(fi.path)
+            if fctx is not None and fctx.is_suppressed(rule.id, fi.line):
+                continue
+            findings.append(fi)
     findings.sort(key=lambda fi: (fi.path, fi.line, fi.col, fi.rule_id))
     return LintResult(findings=findings, files_checked=len(files), parse_errors=parse_errors)

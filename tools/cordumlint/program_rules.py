@@ -1,0 +1,1227 @@
+"""The whole-program rules (CL008-CL011).
+
+Unlike CL001-CL007, these cannot judge a file in isolation: a publish is
+only wrong if *no other file* subscribes, a wire-model field is only dead if
+*nothing anywhere* reads it.  Each rule collects per-file facts during the
+walk and emits findings from ``finalize`` once the fleet-wide picture is
+complete (see :class:`~tools.cordumlint.core.ProgramRule`).
+
+Shared annotation grammar (verified, not trusted — see CL008):
+
+``# cordum: guarded-by(<attr>)``
+    On an ``async def`` (its line, a decorator line, or a comment line
+    directly above): every await-interleaved read-modify-write in the
+    method is intentionally serialized by ``self.<attr>`` at a coarser
+    level than the method body shows.  On a ``self.X = ...`` line: the
+    attribute ``X`` must only be mutated under ``self.<attr>`` — this is
+    also the instrumentation source for the runtime sanitizer
+    (``cordum_tpu/infra/syncsan.py``).  Either way the named lock must be
+    assigned a lock-like object somewhere in the class (or a base class),
+    otherwise the *annotation* is the finding.
+
+``# cordum: single-flight``
+    On an ``async def`` or ``class``: the method (or every method of the
+    class) is only ever executed by one task at a time by construction —
+    a loop pump owned by a single background task, a run-once entry point.
+    Static analysis cannot verify task topology, so this one is trusted;
+    it exists to make the claim grep-able and reviewable.
+
+``# cordum: wire-compat``
+    On a wire-model field: the field is intentionally kept although no
+    in-tree reader remains (legacy peers still decode it).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator, Optional
+
+from .core import Finding, LintContext, ProgramRule
+
+_ANNOT_RE = re.compile(
+    r"#\s*cordum:\s*(?:"
+    r"(?P<guarded>guarded-by\((?P<lock>[A-Za-z_][A-Za-z0-9_]*)\))"
+    r"|(?P<single>single-flight)"
+    r"|(?P<compat>wire-compat)"
+    r")"
+)
+
+
+def collect_annotations(ctx: LintContext) -> dict[int, list[tuple[str, Optional[str]]]]:
+    """Line -> [(kind, lock_attr_or_None)] for every ``# cordum:`` marker."""
+    out: dict[int, list[tuple[str, Optional[str]]]] = {}
+    for i, line in enumerate(ctx.lines, start=1):
+        if "cordum:" not in line:
+            continue
+        for m in _ANNOT_RE.finditer(line):
+            if m.group("guarded"):
+                kind, lock = "guarded-by", m.group("lock")
+            elif m.group("single"):
+                kind, lock = "single-flight", None
+            else:
+                kind, lock = "wire-compat", None
+            out.setdefault(i, []).append((kind, lock))
+    return out
+
+
+def annotations_on_def(
+    ctx: LintContext,
+    ann: dict[int, list[tuple[str, Optional[str]]]],
+    node: ast.AST,
+) -> list[tuple[str, Optional[str], int]]:
+    """Annotations attached to a def/class: on its line, a decorator line,
+    or the contiguous comment block directly above."""
+    first = getattr(node, "lineno", 1)
+    decos = getattr(node, "decorator_list", [])
+    if decos:
+        first = min(first, min(d.lineno for d in decos))
+    out: list[tuple[str, Optional[str], int]] = []
+    for line in range(first, getattr(node, "lineno", first) + 1):
+        for kind, lock in ann.get(line, ()):
+            out.append((kind, lock, line))
+    line = first - 1
+    while line >= 1 and ctx.line_text(line).strip().startswith("#"):
+        for kind, lock in ann.get(line, ()):
+            out.append((kind, lock, line))
+        line -= 1
+    return out
+
+
+def subject_pattern_match(a: str, b: str) -> bool:
+    """Do two subject patterns overlap?  ``*`` matches one token, ``>`` the
+    rest, on either side (a publish to ``worker.*.jobs`` is heard by a
+    subscription to ``worker.*.jobs`` and vice versa)."""
+    ta, tb = a.split("."), b.split(".")
+    i = 0
+    while True:
+        if i < len(ta) and ta[i] == ">":
+            return len(tb) > i
+        if i < len(tb) and tb[i] == ">":
+            return len(ta) > i
+        if i >= len(ta) or i >= len(tb):
+            return len(ta) == len(tb)
+        if ta[i] != tb[i] and ta[i] != "*" and tb[i] != "*":
+            return False
+        i += 1
+
+
+# ---------------------------------------------------------------------------
+# CL008
+# ---------------------------------------------------------------------------
+
+_LOCK_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _RaceScan:
+    """Single execution-ordered pass over one async function body.
+
+    Tracks, per ``self.*`` attribute (and per ``global``-declared name):
+    the await generation + active-lock set at its last read, taint flow
+    into locals, and guard frames (attribute read in an ``if``/``while``
+    test whose body runs after an await).  A write that is *fed by* or
+    *guarded by* a read from an earlier await generation, with no common
+    enclosing ``async with`` lock, is a lost-update / check-then-act race.
+    """
+
+    def __init__(self, global_names: set[str]):
+        self.global_names = global_names
+        self.gen = 0  # await generation: bumps at every suspension point
+        self.reads: dict[str, tuple[int, frozenset[int]]] = {}
+        self.taint: dict[str, set[str]] = {}  # local var -> source attrs
+        # guard frames: (attrs read in test, gen at test, lockset at test)
+        self.guards: list[tuple[set[str], int, frozenset[int]]] = []
+        # attr -> (write_node, read_line, why)
+        self.found: dict[str, tuple[ast.AST, int, str]] = {}
+
+    # -- expression side ------------------------------------------------
+    def eval_expr(self, node: Optional[ast.AST], lockset: frozenset[int]) -> set[str]:
+        """Walk an expression in (approximate) evaluation order; returns the
+        set of tracked attrs whose value flows out of it."""
+        used: set[str] = set()
+        if node is None:
+            return used
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            attr = _self_attr(sub)
+            if attr is not None and isinstance(sub.ctx, ast.Load):
+                used.add(attr)
+                self.reads[attr] = (self.gen, lockset)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                if sub.id in self.global_names:
+                    key = f"global {sub.id}"
+                    used.add(key)
+                    self.reads[key] = (self.gen, lockset)
+                used |= self.taint.get(sub.id, set())
+        # suspension points inside the expression happen before the
+        # enclosing statement's store completes
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(sub, (ast.Await, ast.Yield, ast.YieldFrom)):
+                self.gen += 1
+        return used
+
+    # -- write side ------------------------------------------------------
+    def _write_key(self, target: ast.expr) -> Optional[str]:
+        attr = _self_attr(target)
+        if attr is not None:
+            return attr
+        if isinstance(target, ast.Name) and target.id in self.global_names:
+            return f"global {target.id}"
+        return None
+
+    def record_write(
+        self,
+        key: str,
+        node: ast.AST,
+        value_used: set[str],
+        lockset: frozenset[int],
+    ) -> None:
+        if key in self.found:
+            return
+        if key in value_used:
+            read = self.reads.get(key)
+            if read is not None and read[0] < self.gen and not (read[1] & lockset):
+                self.found[key] = (node, read[0], "read-modify-write")
+                return
+        for guard_attrs, guard_gen, guard_lockset in self.guards:
+            if key in guard_attrs and guard_gen < self.gen and not (
+                guard_lockset & lockset
+            ):
+                self.found[key] = (node, guard_gen, "check-then-act")
+                return
+
+    # -- statement side --------------------------------------------------
+    def walk(self, stmts: list[ast.stmt], lockset: frozenset[int]) -> None:
+        for stmt in stmts:
+            self.stmt(stmt, lockset)
+
+    def stmt(self, node: ast.stmt, lockset: frozenset[int]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Assign):
+            used = self.eval_expr(node.value, lockset)
+            for target in node.targets:
+                key = self._write_key(target)
+                if key is not None:
+                    self.record_write(key, node, used, lockset)
+                elif isinstance(target, ast.Name):
+                    self.taint[target.id] = set(used)
+                else:  # self.d[k] = v / self.a.b = v reads the container
+                    self.eval_expr(target, lockset)
+            return
+        if isinstance(node, ast.AugAssign):
+            key = self._write_key(node.target)
+            used = set() if key is None else {key}
+            if key is not None:
+                self.reads[key] = (self.gen, lockset)
+            used |= self.eval_expr(node.value, lockset)
+            if key is not None:
+                self.record_write(key, node, used, lockset)
+            elif isinstance(node.target, ast.Name):
+                self.taint.setdefault(node.target.id, set()).update(used)
+            return
+        if isinstance(node, ast.AnnAssign):
+            used = self.eval_expr(node.value, lockset)
+            key = self._write_key(node.target)
+            if key is not None:
+                self.record_write(key, node, used, lockset)
+            elif isinstance(node.target, ast.Name):
+                self.taint[node.target.id] = set(used)
+            return
+        if isinstance(node, (ast.Expr, ast.Return, ast.Raise, ast.Assert, ast.Delete)):
+            for child in ast.iter_child_nodes(node):
+                self.eval_expr(child, lockset)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            guard_attrs = self.eval_expr(node.test, lockset)
+            tracked = {a for a in guard_attrs if not a.startswith("__")}
+            self.guards.append((tracked, self.gen, lockset))
+            self.walk(node.body, lockset)
+            self.walk(node.orelse, lockset)
+            self.guards.pop()
+            return
+        if isinstance(node, ast.For):
+            self.eval_expr(node.iter, lockset)
+            self.walk(node.body, lockset)
+            self.walk(node.orelse, lockset)
+            return
+        if isinstance(node, ast.AsyncFor):
+            self.eval_expr(node.iter, lockset)
+            self.gen += 1  # every iteration suspends
+            self.walk(node.body, lockset)
+            self.walk(node.orelse, lockset)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = lockset
+            if isinstance(node, ast.AsyncWith):
+                self.gen += 1  # __aenter__ awaits
+                # `async with self._lock:` / `async with lock:` is mutual
+                # exclusion; `async with timeout(...)`/`session.get(...)`
+                # (a Call) is not
+                if any(
+                    isinstance(item.context_expr, (ast.Name, ast.Attribute))
+                    for item in node.items
+                ):
+                    inner = lockset | {id(node)}
+            for item in node.items:
+                self.eval_expr(item.context_expr, lockset)
+            self.walk(node.body, inner)
+            return
+        if isinstance(node, ast.Try):
+            self.walk(node.body, lockset)
+            for handler in node.handlers:
+                self.walk(handler.body, lockset)
+            self.walk(node.orelse, lockset)
+            self.walk(node.finalbody, lockset)
+            return
+        if isinstance(node, ast.Match):
+            self.eval_expr(node.subject, lockset)
+            for case in node.cases:
+                self.walk(case.body, lockset)
+            return
+        # Pass / Break / Continue / Import / Global / Nonlocal
+        return
+
+
+class AwaitInterleaveRace(ProgramRule):
+    """CL008: read-modify-write of ``self.*`` / module state spanning an
+    ``await`` with no enclosing ``async with <lock>``.  Every ``await`` is a
+    scheduling point: another task can run the same method and interleave,
+    so ``read -> await -> write`` on shared state is a lost update (or a
+    check-then-act double-fire) waiting for load.  Fix with a lock held
+    across the whole read-modify-write, or — when the method is only ever
+    run by one task (a loop pump) — declare it with a verified
+    ``# cordum: guarded-by(<lock>)`` / ``# cordum: single-flight``
+    annotation (see module docstring for the grammar)."""
+
+    id = "CL008"
+    name = "await-interleave-race"
+    description = (
+        "read-modify-write of self.*/module state across an await without "
+        "an enclosing async-with lock; fix or annotate "
+        "(# cordum: guarded-by(lock) / # cordum: single-flight)"
+    )
+
+    def __init__(self, options: Optional[dict] = None):
+        super().__init__(options)
+        # class name -> set of lock-like attribute names it assigns
+        self.class_locks: dict[str, set[str]] = {}
+        # class name -> base class simple names
+        self.class_bases: dict[str, list[str]] = {}
+        # guarded-by annotations to verify: (path, line, class, lock)
+        self.annotations: list[tuple[str, int, str, str]] = []
+        # deferred race findings: (path, line, col, snippet, message, class, waiver_lock)
+        self.candidates: list[tuple[Finding, Optional[str], Optional[str]]] = []
+
+    # -- per-file collection --------------------------------------------
+    def _lock_attrs(self, cls: ast.ClassDef) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not (
+                isinstance(value, ast.Call)
+                and (
+                    (isinstance(value.func, ast.Attribute) and value.func.attr in _LOCK_CTORS)
+                    or (isinstance(value.func, ast.Name) and value.func.id in _LOCK_CTORS)
+                )
+            ):
+                continue
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr is not None:
+                    out.add(attr)
+        return out
+
+    def collect(self, ctx: LintContext) -> None:
+        ann = collect_annotations(ctx)
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            self.class_locks.setdefault(cls.name, set()).update(self._lock_attrs(cls))
+            self.class_bases.setdefault(cls.name, []).extend(
+                b.id for b in cls.bases if isinstance(b, ast.Name)
+            )
+            cls_single = any(
+                kind == "single-flight"
+                for kind, _, _ in annotations_on_def(ctx, ann, cls)
+            )
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._collect_fn(ctx, ann, cls.name, fn, cls_single)
+        # attribute-level guarded-by declarations (`self.x = 0  # cordum:
+        # guarded-by(_lock)`) also need their lock verified; find them by
+        # line rather than re-walking — only assignment lines count (the
+        # def-attached form is handled above, and double-recording it
+        # would double-report a bogus lock)
+        for line, markers in ann.items():
+            if not re.search(r"self\.\w+\s*[:=]", ctx.line_text(line)):
+                continue
+            for kind, lock in markers:
+                if kind != "guarded-by" or lock is None:
+                    continue
+                owner = self._class_at_line(ctx, line)
+                if owner is not None:
+                    self.annotations.append((ctx.rel_path, line, owner, lock))
+        # module-level async functions
+        for fn in ctx.tree.body:
+            if isinstance(fn, ast.AsyncFunctionDef):
+                self._collect_fn(ctx, ann, "", fn, False)
+
+    def _class_at_line(self, ctx: LintContext, line: int) -> Optional[str]:
+        best: Optional[ast.ClassDef] = None
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef) and cls.lineno <= line <= (
+                cls.end_lineno or cls.lineno
+            ):
+                if best is None or cls.lineno > best.lineno:
+                    best = cls
+        return best.name if best is not None else None
+
+    def _collect_fn(
+        self,
+        ctx: LintContext,
+        ann: dict[int, list[tuple[str, Optional[str]]]],
+        class_name: str,
+        fn: ast.AST,
+        cls_single: bool,
+    ) -> None:
+        markers = annotations_on_def(ctx, ann, fn)
+        waiver_lock: Optional[str] = None
+        waived = cls_single
+        for kind, lock, _line in markers:
+            if kind == "single-flight":
+                waived = True
+            elif kind == "guarded-by" and lock is not None:
+                waived = True
+                waiver_lock = lock
+                self.annotations.append((ctx.rel_path, fn.lineno, class_name, lock))
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            return
+        global_names: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                global_names.update(node.names)
+        scan = _RaceScan(global_names)
+        scan.walk(fn.body, frozenset())
+        for attr, (node, read_gen, why) in sorted(
+            scan.found.items(), key=lambda kv: kv[1][0].lineno
+        ):
+            target = attr if attr.startswith("global ") else f"self.{attr}"
+            fi = self.finding(
+                ctx, node,
+                f"{why} race: {target} is read before an await and written "
+                f"after it in async {fn.name}() — another task can "
+                "interleave at the await and its update is lost; hold one "
+                "async-with lock across the read and the write, or declare "
+                "the single-writer topology with a verified "
+                "`# cordum: guarded-by(<lock>)` / `# cordum: single-flight` "
+                "annotation",
+            )
+            if not waived:
+                self.candidates.append((fi, class_name, waiver_lock))
+
+    # -- fleet-wide verification ----------------------------------------
+    def _resolve_lock(self, class_name: str, lock: str) -> bool:
+        seen: set[str] = set()
+        stack = [class_name]
+        while stack:
+            cls = stack.pop()
+            if cls in seen:
+                continue
+            seen.add(cls)
+            if lock in self.class_locks.get(cls, ()):
+                return True
+            stack.extend(self.class_bases.get(cls, ()))
+        return False
+
+    def finalize(
+        self, root: Path, contexts: dict[str, LintContext]
+    ) -> Iterator[Finding]:
+        for fi, class_name, _waiver in self.candidates:
+            yield fi
+        seen: set[tuple[str, int, str]] = set()
+        for path, line, class_name, lock in self.annotations:
+            key = (path, line, lock)
+            if key in seen:
+                continue
+            seen.add(key)
+            if not class_name or not self._resolve_lock(class_name, lock):
+                where = f"class {class_name}" if class_name else "any class"
+                yield self.finding_at(
+                    path, line,
+                    f"annotation error: `# cordum: guarded-by({lock})` names "
+                    f"a lock attribute that {where} never assigns a lock-like "
+                    "object (asyncio/threading Lock, RLock, Condition, "
+                    "Semaphore) — the waiver is unverifiable",
+                    contexts,
+                )
+
+
+# ---------------------------------------------------------------------------
+# CL009
+# ---------------------------------------------------------------------------
+
+_PUBLISH_METHODS = {"publish", "publish_wait", "request"}
+_SUBSCRIBE_METHODS = {"subscribe"}
+# helper name -> family builder over the constants map; every family the
+# helper can produce is listed (the partitioned helpers fall back to the
+# parent subject when unsharded)
+_HELPER_FAMILIES = {
+    "direct_subject": lambda c: ["worker.*.jobs"],
+    "gang_subject": lambda c: [c.get("GANG_PREFIX", "sys.job.gang.") + "*"],
+    "telemetry_subject": lambda c: [c.get("TELEMETRY_PREFIX", "sys.telemetry.") + "*"],
+    "submit_subject": lambda c: [c.get("SUBMIT", ""), c.get("SUBMIT", "") + ".*"],
+    "submit_subject_for": lambda c: [c.get("SUBMIT", ""), c.get("SUBMIT", "") + ".*"],
+    "result_subject": lambda c: [c.get("RESULT", ""), c.get("RESULT", "") + ".*"],
+    "stamped_result_subject": lambda c: [c.get("RESULT", ""), c.get("RESULT", "") + ".*"],
+    "cancel_subject": lambda c: [c.get("CANCEL", ""), c.get("CANCEL", "") + ".*"],
+}
+
+
+class _Site:
+    __slots__ = ("kind", "symbol", "path", "line", "snippet")
+
+    def __init__(self, kind: str, symbol: tuple[str, str], path: str, line: int,
+                 snippet: str):
+        self.kind = kind
+        self.symbol = symbol  # ("const", NAME) | ("helper", name)
+        self.path = path
+        self.line = line
+        self.snippet = snippet
+
+
+class SubjectGraphConformance(ProgramRule):
+    """CL009: the fleet-wide publish/subscribe graph must close.  Every
+    published subject family needs >=1 subscription that can hear it
+    (wildcards resolved), every subscription a publisher, and the graph
+    must agree with the subject table in ``docs/PROTOCOL.md`` — including
+    each row's durable/best-effort column, cross-checked against the
+    ``is_durable_subject`` contract.  A publish nobody hears is a silent
+    drop (an at-least-once bus redelivers it into the void); a stale doc
+    row is how the next integration partner wires the wrong subject.
+    Rows whose Purpose contains ``external`` are exempt from the
+    in-tree-subscriber requirement."""
+
+    id = "CL009"
+    name = "subject-graph-conformance"
+    description = (
+        "published subjects need an in-tree subscriber (and vice versa); "
+        "the graph and durability must match docs/PROTOCOL.md"
+    )
+
+    def __init__(self, options: Optional[dict] = None):
+        super().__init__(options)
+        self.constants: dict[str, str] = {}
+        # (rel_path, local name) or ("", attr name) -> bound symbol
+        self.aliases: dict[tuple[str, str], tuple[str, str]] = {}
+        self.sites: list[_Site] = []
+        self.doc_rel = self.options.get("protocol_doc", "docs/PROTOCOL.md")
+
+    # -- collection ------------------------------------------------------
+    def collect(self, ctx: LintContext) -> None:
+        if ctx.rel_path.endswith("protocol/subjects.py"):
+            for node in ctx.tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    self.constants[node.targets[0].id] = node.value.value
+        # alias pass: `self.subject = subj.telemetry_subject(svc)` /
+        # `target = subj.RESULT` bind a name that later publish/subscribe
+        # calls use — resolve those through a name-keyed alias map
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            symbol = self._symbol(node.value)
+            if symbol is None or symbol[0] not in ("const", "helper"):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    # locals/module names stay file-scoped: `subject` is a
+                    # common forwarder parameter name elsewhere
+                    self.aliases[(ctx.rel_path, target.id)] = symbol
+                elif isinstance(target, ast.Attribute):
+                    self.aliases[("", target.attr)] = symbol
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            method = node.func.attr
+            if method in _PUBLISH_METHODS:
+                kind = "publish"
+            elif method in _SUBSCRIBE_METHODS:
+                kind = "subscribe"
+            else:
+                continue
+            if not node.args:
+                continue
+            symbol = self._symbol(node.args[0])
+            if symbol is None:
+                continue
+            self.sites.append(_Site(
+                kind, symbol, ctx.rel_path, node.lineno,
+                ctx.line_text(node.lineno).strip(),
+            ))
+
+    def _symbol(self, arg: ast.expr) -> Optional[tuple[str, str]]:
+        # subj.CONST / subjects.CONST / bare imported CONST
+        if isinstance(arg, ast.Attribute) and arg.attr.isupper():
+            return ("const", arg.attr)
+        if isinstance(arg, ast.Name) and arg.id.isupper():
+            return ("const", arg.id)
+        fn = arg.func if isinstance(arg, ast.Call) else None
+        name = ""
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+        elif isinstance(fn, ast.Name):
+            name = fn.id
+        if name in _HELPER_FAMILIES:
+            return ("helper", name)
+        # plain name / attribute: may be an alias bound from a constant or
+        # helper elsewhere — resolved against the alias map at finalize
+        if isinstance(arg, ast.Name):
+            return ("local", arg.id)
+        if isinstance(arg, ast.Attribute) and isinstance(arg.value, (ast.Name, ast.Attribute)):
+            return ("attr", arg.attr)
+        return None  # dynamic subject (forwarders): out of scope
+
+    # -- doc table -------------------------------------------------------
+    def _parse_doc(self, root: Path) -> Optional[list[dict]]:
+        """Rows of the `## Subjects` table: {patterns, durable, external,
+        line}."""
+        doc = root / self.doc_rel
+        if not doc.exists():
+            return None
+        rows: list[dict] = []
+        in_section = False
+        for i, line in enumerate(doc.read_text(encoding="utf-8").splitlines(), 1):
+            if line.startswith("#"):
+                in_section = line.lstrip("#").strip().lower() == "subjects"
+                continue
+            if not in_section or not line.startswith("|"):
+                continue
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if len(cells) < 3 or cells[0].lower() == "subject" or set(cells[0]) <= {"-"}:
+                continue
+            patterns = []
+            for chunk in re.split(r"[,/]", cells[0]):
+                subject = chunk.strip().strip("`").strip()
+                if not subject:
+                    continue
+                patterns.append(re.sub(r"<[^>]*>", "*", subject))
+            rows.append({
+                "patterns": patterns,
+                "durable": "durable" in cells[1].lower(),
+                "external": "external" in cells[2].lower(),
+                "line": i,
+                "raw": cells[0],
+            })
+        return rows
+
+    # -- durability mirror ----------------------------------------------
+    def _mirror_is_durable(self, pattern: str) -> bool:
+        c = self.constants
+        submit = c.get("SUBMIT", "sys.job.submit")
+        result = c.get("RESULT", "sys.job.result")
+        cancel = c.get("CANCEL", "sys.job.cancel")
+        if pattern in (submit, result, c.get("DLQ", "sys.job.dlq"),
+                       c.get("TRACE_SPAN", "sys.trace.span")):
+            return True
+        for parent in (submit, result, cancel):
+            if pattern.startswith(parent + "."):
+                return True
+        if pattern.startswith(c.get("JOB_PREFIX", "job.")):
+            return True
+        if pattern.startswith(c.get("WORKER_PREFIX", "worker.")) and pattern.endswith(".jobs"):
+            return True
+        return False
+
+    # -- finalize --------------------------------------------------------
+    def finalize(
+        self, root: Path, contexts: dict[str, LintContext]
+    ) -> Iterator[Finding]:
+        if not self.constants:
+            return  # no subjects.py in the linted set: nothing to resolve
+        published: dict[str, _Site] = {}
+        subscribed: dict[str, _Site] = {}
+        for site in self.sites:
+            for pattern in self._resolve(site):
+                bucket = published if site.kind == "publish" else subscribed
+                bucket.setdefault(pattern, site)
+        rows = self._parse_doc(root)
+        external = set()
+        if rows is not None:
+            for row in rows:
+                if row["external"]:
+                    external.update(row["patterns"])
+
+        for pattern, site in sorted(published.items()):
+            if any(subject_pattern_match(pattern, s) for s in subscribed):
+                continue
+            if any(subject_pattern_match(pattern, e) for e in external):
+                continue
+            yield self.finding_at(
+                site.path, site.line,
+                f"orphan publish: nothing in the tree subscribes to "
+                f"'{pattern}' — wire up a subscriber, delete the publish, or "
+                "document the subject as external in docs/PROTOCOL.md",
+                contexts,
+            )
+        for pattern, site in sorted(subscribed.items()):
+            if any(subject_pattern_match(pattern, p) for p in published):
+                continue
+            if any(subject_pattern_match(pattern, e) for e in external):
+                continue
+            yield self.finding_at(
+                site.path, site.line,
+                f"orphan subscription: nothing in the tree publishes to "
+                f"'{pattern}' — the handler is dead code or the publisher "
+                "was renamed out from under it",
+                contexts,
+            )
+
+        if rows is None:
+            return
+        doc_patterns = [p for row in rows for p in row["patterns"]]
+        families = set(published) | set(subscribed)
+        for pattern, site in sorted({**subscribed, **published}.items()):
+            if any(subject_pattern_match(pattern, d) for d in doc_patterns):
+                continue
+            yield self.finding_at(
+                site.path, site.line,
+                f"doc drift: subject family '{pattern}' is used here but has "
+                f"no row in the {self.doc_rel} Subjects table",
+                contexts,
+            )
+        for row in rows:
+            for pattern in row["patterns"]:
+                if not row["external"] and not any(
+                    subject_pattern_match(pattern, f) for f in families
+                ):
+                    yield self.finding_at(
+                        self.doc_rel, row["line"],
+                        f"doc drift: {self.doc_rel} documents subject "
+                        f"'{row['raw']}' but nothing in the tree publishes or "
+                        "subscribes to it",
+                        contexts,
+                    )
+                    continue
+                durable = self._mirror_is_durable(pattern)
+                if durable != row["durable"]:
+                    actual = "durable" if durable else "best-effort"
+                    yield self.finding_at(
+                        self.doc_rel, row["line"],
+                        f"durability drift: {self.doc_rel} marks "
+                        f"'{row['raw']}' as "
+                        f"{'durable' if row['durable'] else 'best-effort'} "
+                        f"but protocol/subjects.py is_durable_subject says "
+                        f"{actual}",
+                        contexts,
+                    )
+
+    def _resolve(self, site: _Site) -> list[str]:
+        kind, name = site.symbol
+        if kind == "local":
+            alias = self.aliases.get((site.path, name))
+            if alias is None:
+                return []  # genuinely dynamic (forwarders): out of scope
+            kind, name = alias
+        elif kind == "attr":
+            alias = self.aliases.get(("", name))
+            if alias is None:
+                return []
+            kind, name = alias
+        if kind == "const":
+            value = self.constants.get(name)
+            return [value] if value else []
+        return [p for p in _HELPER_FAMILIES[name](self.constants) if p]
+
+
+# ---------------------------------------------------------------------------
+# CL010
+# ---------------------------------------------------------------------------
+
+
+class WireModelDrift(ProgramRule):
+    """CL010: wire-model fields that are encoded but never read anywhere in
+    the tree (dead weight on every packet, and a trap: readers assume the
+    writer keeps populating it), or read but never set (always the default —
+    the reader is testing a value nobody produces).  Liveness is name-based
+    across the whole tree: an attribute load, ``pkt["field"]`` /
+    ``.get("field")`` subscript, or ``getattr`` read keeps a field alive.
+    Fields intentionally kept for legacy peers carry
+    ``# cordum: wire-compat``.  Also cross-checks msgpack record keys:
+    a key subscripted out of an ``unpack_record()`` result that no literal
+    ``pack_record({...})`` site ever writes is a reader expecting a record
+    shape no writer produces."""
+
+    id = "CL010"
+    name = "wire-model-drift"
+    description = (
+        "protocol/types.py dataclass fields encoded-but-never-read / "
+        "read-but-never-set, and unpack_record keys no pack_record writes"
+    )
+
+    def __init__(self, options: Optional[dict] = None):
+        super().__init__(options)
+        self.types_glob = self.options.get("types_path", "*protocol/types.py")
+        # class -> [(field, line, path, compat)]
+        self.fields: dict[str, list[tuple[str, int, str, bool]]] = {}
+        self.field_order: dict[str, list[str]] = {}
+        self.reads: set[str] = set()
+        self.stores: set[str] = set()
+        self.ctor_stores: dict[str, set[str]] = {}
+        self.pack_keys: set[str] = set()
+        self.opaque_pack = False
+        self.unpack_reads: list[tuple[str, str, int]] = []  # key, path, line
+
+    # -- collection ------------------------------------------------------
+    def collect(self, ctx: LintContext) -> None:
+        import fnmatch as _fn
+
+        if _fn.fnmatch(ctx.rel_path, self.types_glob):
+            self._collect_models(ctx)
+        self._collect_usage(ctx)
+        self._collect_records(ctx)
+
+    def _collect_models(self, ctx: LintContext) -> None:
+        ann = collect_annotations(ctx)
+        for cls in ctx.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            is_dc = any(
+                (isinstance(d, ast.Name) and d.id == "dataclass")
+                or (isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                    and d.func.id == "dataclass")
+                or (isinstance(d, ast.Attribute) and d.attr == "dataclass")
+                or (isinstance(d, ast.Call) and isinstance(d.func, ast.Attribute)
+                    and d.func.attr == "dataclass")
+                for d in cls.decorator_list
+            )
+            if not is_dc:
+                continue
+            fields: list[tuple[str, int, str, bool]] = []
+            order: list[str] = []
+            for stmt in cls.body:
+                if not (isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name)):
+                    continue
+                anno = stmt.annotation
+                anno_name = ""
+                if isinstance(anno, ast.Subscript) and isinstance(anno.value, ast.Name):
+                    anno_name = anno.value.id
+                elif isinstance(anno, ast.Name):
+                    anno_name = anno.id
+                if anno_name == "ClassVar":
+                    continue
+                name = stmt.target.id
+                if name.startswith("_"):
+                    continue
+                compat = any(
+                    kind == "wire-compat" for kind, _ in ann.get(stmt.lineno, ())
+                ) or any(
+                    kind == "wire-compat" for kind, _ in ann.get(stmt.lineno - 1, ())
+                    if ctx.line_text(stmt.lineno - 1).strip().startswith("#")
+                )
+                fields.append((name, stmt.lineno, ctx.rel_path, compat))
+                order.append(name)
+            if fields:
+                self.fields[cls.name] = fields
+                self.field_order[cls.name] = order
+
+    def _collect_usage(self, ctx: LintContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                if isinstance(node.ctx, ast.Load):
+                    self.reads.add(node.attr)
+                elif isinstance(node.ctx, ast.Store):
+                    self.stores.add(node.attr)
+            elif isinstance(node, ast.Subscript):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    if isinstance(node.ctx, ast.Store):
+                        self.stores.add(sl.value)
+                    else:
+                        self.reads.add(sl.value)
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                fname = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else ""
+                )
+                if fname in ("get", "getattr", "pop") and node.args:
+                    arg0 = node.args[1] if fname == "getattr" and len(node.args) > 1 \
+                        else node.args[0]
+                    if isinstance(arg0, ast.Constant) and isinstance(arg0.value, str):
+                        self.reads.add(arg0.value)
+                if fname == "setattr" and len(node.args) > 1:
+                    arg1 = node.args[1]
+                    if isinstance(arg1, ast.Constant) and isinstance(arg1.value, str):
+                        self.stores.add(arg1.value)
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        self.stores.add(kw.arg)
+                if isinstance(fn, ast.Name) and node.args:
+                    self.ctor_stores.setdefault(fn.id, set()).update(
+                        str(i) for i in range(len(node.args))
+                    )
+            elif isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                        self.stores.add(key.value)
+
+    def _collect_records(self, ctx: LintContext) -> None:
+        def fname(call: ast.Call) -> str:
+            fn = call.func
+            if isinstance(fn, ast.Name):
+                return fn.id
+            if isinstance(fn, ast.Attribute):
+                return fn.attr
+            return ""
+
+        for scope in ast.walk(ctx.tree):
+            if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+                continue
+            body_nodes = [
+                n for n in ast.walk(scope)
+                if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                or n is scope
+            ]
+            unpacked: set[str] = set()
+            dict_lits: dict[str, set[str]] = {}
+            for node in body_nodes:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    var = node.targets[0].id
+                    if isinstance(node.value, ast.Call) and fname(node.value) == "unpack_record":
+                        unpacked.add(var)
+                    elif isinstance(node.value, ast.Dict):
+                        keys = {
+                            k.value for k in node.value.keys
+                            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        }
+                        if keys:
+                            dict_lits[var] = keys
+            for node in body_nodes:
+                if isinstance(node, ast.Call) and fname(node) == "pack_record" and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Dict):
+                        for k in arg.keys:
+                            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                                self.pack_keys.add(k.value)
+                            else:
+                                self.opaque_pack = True
+                    elif isinstance(arg, ast.Name) and arg.id in dict_lits:
+                        self.pack_keys.update(dict_lits[arg.id])
+                    else:
+                        self.opaque_pack = True
+                if (
+                    isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in unpacked
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                    and isinstance(node.ctx, ast.Load)
+                ):
+                    self.unpack_reads.append(
+                        (node.slice.value, ctx.rel_path, node.lineno)
+                    )
+
+    # -- finalize --------------------------------------------------------
+    def finalize(
+        self, root: Path, contexts: dict[str, LintContext]
+    ) -> Iterator[Finding]:
+        for cls, fields in sorted(self.fields.items()):
+            order = self.field_order[cls]
+            positional = {
+                order[int(i)]
+                for i in self.ctor_stores.get(cls, ())
+                if int(i) < len(order)
+            }
+            for name, line, path, compat in fields:
+                if compat:
+                    continue
+                if name not in self.reads:
+                    yield self.finding_at(
+                        path, line,
+                        f"dead wire field: {cls}.{name} is encoded on every "
+                        "packet but nothing in the tree ever reads it — "
+                        "prune it (legacy decode stays tolerant via "
+                        "from_dict) or mark it `# cordum: wire-compat`",
+                        contexts,
+                    )
+                elif name not in self.stores and name not in positional:
+                    yield self.finding_at(
+                        path, line,
+                        f"never-set wire field: {cls}.{name} is read but no "
+                        "constructor call, attribute write, or dict literal "
+                        "anywhere sets it — readers always see the default",
+                        contexts,
+                    )
+        if not self.opaque_pack and self.pack_keys:
+            seen: set[str] = set()
+            for key, path, line in sorted(self.unpack_reads):
+                if key in self.pack_keys or key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding_at(
+                    path, line,
+                    f"record-key drift: this unpack_record() reader indexes "
+                    f"['{key}'] but no pack_record() writer in the tree ever "
+                    "writes that key",
+                    contexts,
+                )
+
+
+# ---------------------------------------------------------------------------
+# CL011
+# ---------------------------------------------------------------------------
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+_METRIC_WRITES = {"inc", "observe", "set", "dec"}
+_NON_LABEL_KWARGS = {"exemplar", "amount", "value"}
+
+INVENTORY_BEGIN = "<!-- cordumlint: metrics-inventory begin -->"
+INVENTORY_END = "<!-- cordumlint: metrics-inventory end -->"
+
+
+class MetricsConformance(ProgramRule):
+    """CL011: every ``cordum_*`` metric family must be written with one
+    consistent label schema at every call site (two sites disagreeing on
+    label names silently split one family into disjoint series — dashboards
+    aggregate half the truth) and must be documented in
+    ``docs/OBSERVABILITY.md``, whose generated inventory table
+    (``python -m tools.cordumlint --write-obs-inventory``) must list the
+    exact label set the code uses."""
+
+    id = "CL011"
+    name = "metrics-conformance"
+    description = (
+        "cordum_* metrics need one label schema across all call sites and a "
+        "matching row/mention in docs/OBSERVABILITY.md"
+    )
+
+    def __init__(self, options: Optional[dict] = None):
+        super().__init__(options)
+        self.doc_rel = self.options.get("observability_doc", "docs/OBSERVABILITY.md")
+        # metric name -> (type, help, path, line)
+        self.defs: dict[str, tuple[str, str, str, int]] = {}
+        # handle attr/var name -> metric name
+        self.handles: dict[str, str] = {}
+        # raw write sites: (recv_key_or_name, labels_or_None, path, line)
+        self.raw_sites: list[tuple[Optional[str], Optional[frozenset[str]], str, int]] = []
+
+    def collect(self, ctx: LintContext) -> None:
+        # pass 1: definitions + handle bindings (file-order independent)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ctor = self._ctor_name(node)
+            if ctor is None or not node.args:
+                continue
+            arg0 = node.args[0]
+            if not (isinstance(arg0, ast.Constant) and isinstance(arg0.value, str)
+                    and arg0.value.startswith("cordum_")):
+                continue
+            help_ = ""
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                help_ = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "help_" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    help_ = kw.value.value
+            if arg0.value not in self.defs:
+                self.defs[arg0.value] = (ctor.lower(), help_, ctx.rel_path, node.lineno)
+            parent = ctx.parent(node)
+            if isinstance(parent, ast.Assign):
+                for target in parent.targets:
+                    if isinstance(target, ast.Attribute):
+                        self.handles[target.attr] = arg0.value
+                    elif isinstance(target, ast.Name):
+                        self.handles[target.id] = arg0.value
+        # pass 2: write sites
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            if node.func.attr not in _METRIC_WRITES:
+                continue
+            recv = node.func.value
+            key: Optional[str] = None
+            if isinstance(recv, ast.Call):
+                ctor = self._ctor_name(recv)
+                if ctor and recv.args and isinstance(recv.args[0], ast.Constant):
+                    key = str(recv.args[0].value)
+            elif isinstance(recv, ast.Attribute):
+                key = recv.attr
+            elif isinstance(recv, ast.Name):
+                key = recv.id
+            if key is None:
+                continue
+            labels: Optional[frozenset[str]] = frozenset(
+                kw.arg for kw in node.keywords
+                if kw.arg is not None and kw.arg not in _NON_LABEL_KWARGS
+            )
+            if any(kw.arg is None for kw in node.keywords):
+                labels = None  # **labels passthrough: schema unknown here
+            self.raw_sites.append((key, labels, ctx.rel_path, node.lineno))
+
+    def _ctor_name(self, node: ast.Call) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in _METRIC_CTORS:
+            return fn.id
+        if isinstance(fn, ast.Attribute) and fn.attr in _METRIC_CTORS:
+            return fn.attr
+        return None
+
+    # -- shared with the inventory generator -----------------------------
+    def resolved_schemas(self) -> dict[str, dict[frozenset[str], list[tuple[str, int]]]]:
+        """metric name -> label-set -> [(path, line)] across resolved write
+        sites (sites whose receiver isn't a known handle are skipped —
+        they're some other object's .set/.inc)."""
+        out: dict[str, dict[frozenset[str], list[tuple[str, int]]]] = {}
+        for key, labels, path, line in self.raw_sites:
+            name = key if key in self.defs else self.handles.get(key or "")
+            if name is None or name not in self.defs:
+                continue
+            if labels is None:
+                continue
+            out.setdefault(name, {}).setdefault(labels, []).append((path, line))
+        return out
+
+    def inventory_rows(self) -> list[tuple[str, str, str, str]]:
+        """(name, type, labels-cell, help) rows for the generated table."""
+        schemas = self.resolved_schemas()
+        rows = []
+        for name in sorted(self.defs):
+            type_, help_, _p, _l = self.defs[name]
+            label_union: set[str] = set()
+            for labels in schemas.get(name, ()):  # post-CL011 there is one
+                label_union |= labels
+            cell = ", ".join(sorted(label_union)) if label_union else "—"
+            rows.append((name, type_, cell, help_))
+        return rows
+
+    def finalize(
+        self, root: Path, contexts: dict[str, LintContext]
+    ) -> Iterator[Finding]:
+        schemas = self.resolved_schemas()
+        for name, by_schema in sorted(schemas.items()):
+            if len(by_schema) <= 1:
+                continue
+            modal = max(by_schema.items(), key=lambda kv: len(kv[1]))[0]
+            for labels, sites in sorted(by_schema.items(), key=lambda kv: sorted(kv[0])):
+                if labels == modal:
+                    continue
+                path, line = sites[0]
+                yield self.finding_at(
+                    path, line,
+                    f"label-schema drift: {name} is written here with labels "
+                    f"{{{', '.join(sorted(labels)) or 'none'}}} but its other "
+                    f"call sites use {{{', '.join(sorted(modal)) or 'none'}}} "
+                    "— one family, one schema",
+                    contexts,
+                )
+        doc = root / self.doc_rel
+        if not doc.exists():
+            return
+        text = doc.read_text(encoding="utf-8")
+        inventory = None
+        if INVENTORY_BEGIN in text and INVENTORY_END in text:
+            inventory = text.split(INVENTORY_BEGIN, 1)[1].split(INVENTORY_END, 1)[0]
+        for name, (_type, _help, path, line) in sorted(self.defs.items()):
+            if name not in text:
+                yield self.finding_at(
+                    path, line,
+                    f"undocumented metric: {name} is not mentioned anywhere "
+                    f"in {self.doc_rel} — document it (and regenerate the "
+                    "inventory: python -m tools.cordumlint "
+                    "--write-obs-inventory)",
+                    contexts,
+                )
+        if inventory is not None:
+            documented: dict[str, set[str]] = {}
+            for line_text in inventory.splitlines():
+                if not line_text.startswith("|"):
+                    continue
+                cells = [c.strip() for c in line_text.strip().strip("|").split("|")]
+                if len(cells) < 3 or cells[0].lower() == "metric" or set(cells[0]) <= {"-"}:
+                    continue
+                mname = cells[0].strip("`")
+                labels = {
+                    s.strip() for s in cells[2].split(",")
+                    if s.strip() and s.strip() != "—"
+                }
+                documented[mname] = labels
+            for name, type_, cell, _help in self.inventory_rows():
+                want = {s.strip() for s in cell.split(",") if s.strip() and s.strip() != "—"}
+                if name not in documented:
+                    _t, _h, path, line = self.defs[name]
+                    yield self.finding_at(
+                        path, line,
+                        f"inventory drift: {name} is missing from the "
+                        f"generated metric inventory in {self.doc_rel}; "
+                        "regenerate it (python -m tools.cordumlint "
+                        "--write-obs-inventory)",
+                        contexts,
+                    )
+                elif documented[name] != want:
+                    _t, _h, path, line = self.defs[name]
+                    yield self.finding_at(
+                        path, line,
+                        f"inventory drift: {self.doc_rel} lists {name} with "
+                        f"labels {{{', '.join(sorted(documented[name])) or 'none'}}} "
+                        f"but the code writes {{{', '.join(sorted(want)) or 'none'}}}; "
+                        "regenerate the inventory",
+                        contexts,
+                    )
+            stale = set(documented) - set(self.defs)
+            if stale:
+                yield self.finding_at(
+                    self.doc_rel, 1,
+                    "inventory drift: the generated inventory lists metrics "
+                    f"the code no longer defines: {', '.join(sorted(stale))}; "
+                    "regenerate it",
+                    contexts,
+                )
+
+
+def render_inventory(rule: MetricsConformance) -> str:
+    lines = [
+        INVENTORY_BEGIN,
+        "<!-- generated by `python -m tools.cordumlint --write-obs-inventory`;",
+        "     do not edit by hand — CL011 fails lint when this table drifts -->",
+        "",
+        "| Metric | Type | Labels | Help |",
+        "|---|---|---|---|",
+    ]
+    for name, type_, labels, help_ in rule.inventory_rows():
+        lines.append(f"| `{name}` | {type_} | {labels} | {help_} |")
+    lines.append("")
+    lines.append(INVENTORY_END)
+    return "\n".join(lines)
+
+
+PROGRAM_RULES = (
+    AwaitInterleaveRace,
+    SubjectGraphConformance,
+    WireModelDrift,
+    MetricsConformance,
+)

@@ -442,6 +442,8 @@ class NoJsonOnHotPath(Rule):
                 )
 
 
+from .program_rules import PROGRAM_RULES  # noqa: E402 - registry lives here
+
 RULES: tuple[type[Rule], ...] = (
     NoWallClockDeadline,
     NoSilentSwallow,
@@ -450,4 +452,4 @@ RULES: tuple[type[Rule], ...] = (
     SubjectLiterals,
     JaxCompatKwargs,
     NoJsonOnHotPath,
-)
+) + PROGRAM_RULES
