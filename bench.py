@@ -2215,6 +2215,266 @@ async def bench_storm(smoke: bool = True) -> dict:
     }
 
 
+async def bench_agents(smoke: bool = True) -> dict:
+    """Agent-loop storm (ISSUE 17, docs/WORKFLOWS.md §Storm harness):
+    loadgen-driven multi-step agent workflows — llm.generate → context.update
+    → context.window (RAG) → llm.generate — through the REAL pipeline:
+    gateway-style admission at run start, workflow engine dispatch, scheduler
+    session/batch-affinity routing, simulated serving workers that track
+    per-session prefill state, context embeds as pool jobs (BusEmbedder),
+    and workflow resume via the queue-group result consumer + reconciler.
+
+    The agent-serving invariants under load:
+      * ``agents_affinity_hit_rate`` — steady-state generate turns route to
+        the worker already holding the session's KV pages;
+      * ``agents_reprefills`` — sessions that cold-prefilled on a second
+        worker (the no-re-prefill acceptance bar: 0);
+      * ``agents_workflow_steps_per_sec`` / ``agents_step_p99_ms`` — the
+        control plane's step engine keeps up (floors in bench_floor.json);
+      * ``agents_context_embeds_per_sec`` — context embeds ride the real
+        worker path as micro-batchable pool jobs."""
+    from cordum_tpu.context.service import BusEmbedder, ContextService
+    from cordum_tpu.controlplane.gateway.admission import AdmissionController
+    from cordum_tpu.controlplane.safetykernel.kernel import SafetyKernel
+    from cordum_tpu.controlplane.scheduler.engine import Engine as SchedEngine
+    from cordum_tpu.controlplane.scheduler.safety_client import SafetyClient
+    from cordum_tpu.controlplane.scheduler.strategy import LeastLoadedStrategy
+    from cordum_tpu.controlplane.workflowengine.service import (
+        WorkflowEngineService,
+    )
+    from cordum_tpu.infra.bus import LoopbackBus
+    from cordum_tpu.infra.config import parse_pool_config
+    from cordum_tpu.infra.jobstore import JobStore
+    from cordum_tpu.infra.kv import MemoryKV
+    from cordum_tpu.infra.loadgen import LoadGen, TenantSpec
+    from cordum_tpu.infra.memstore import MemoryStore
+    from cordum_tpu.infra.registry import WorkerRegistry
+    from cordum_tpu.obs import FleetAggregator
+    from cordum_tpu.infra.metrics import Metrics
+    from cordum_tpu.protocol import subjects as subj
+    from cordum_tpu.protocol.types import (
+        BusPacket, Heartbeat, JobResult, LABEL_OP, LABEL_SESSION_KEY,
+        LABEL_SLO_CLASS,
+    )
+    from cordum_tpu.workflow import models as WM
+    from cordum_tpu.workflow.engine import Engine as WfEngine
+    from cordum_tpu.workflow.models import Workflow
+    from cordum_tpu.workflow.store import WorkflowStore
+
+    kv = MemoryKV()
+    bus = LoopbackBus()
+    mem = MemoryStore(kv)
+    js = JobStore(kv)
+    kernel = SafetyKernel(policy_doc={
+        "tenants": {"default": {"allow_topics": ["job.*", "job.>"]}},
+    })
+    reg = WorkerRegistry()
+    pc = parse_pool_config({
+        "topics": {"job.tpu.generate": "tpu", "job.tpu.embed": "tpu"},
+        "pools": {"tpu": {}},
+    })
+    strategy = LeastLoadedStrategy(reg, pc)
+    sched = SchedEngine(bus=bus, job_store=js, safety=SafetyClient(kernel.check),
+                        strategy=strategy, registry=reg)
+    await sched.start()
+
+    # -- simulated serving workers: per-session prefill state makes cold
+    # starts observable — a session's first generate on a worker pays a
+    # prefill; any LATER prefill of the same session is a re-prefill (the
+    # affinity miss the tentpole forbids)
+    n_workers = 3
+    session_workers: dict[str, set] = {}
+    prefills = [0]
+    embedded = [0]
+    decode_ms, prefill_ms, embed_ms = 2.0, 8.0, 2.0
+    for w in range(n_workers):
+        wid = f"agent-w{w}"
+        reg.update(Heartbeat(worker_id=wid, pool="tpu",
+                             max_parallel_jobs=1 << 30))
+
+        def make_handler(wid=wid):
+            async def handler(subject, pkt):
+                req = pkt.job_request
+                if req is None:
+                    return
+                t0 = time.perf_counter()
+                op = (req.labels or {}).get(LABEL_OP, "")
+                if op == "llm.generate":
+                    skey = (req.labels or {}).get(LABEL_SESSION_KEY, "")
+                    if skey:
+                        owners = session_workers.setdefault(skey, set())
+                        if wid not in owners:
+                            owners.add(wid)
+                            prefills[0] += 1
+                            await asyncio.sleep(prefill_ms / 1000.0)
+                    await asyncio.sleep(decode_ms / 1000.0)
+                    out = {"text": f"gen:{req.job_id}", "tokens": 8}
+                elif op == "embed":
+                    payload = await mem.get_context(req.context_ptr) or {}
+                    texts = payload.get("texts") or []
+                    await asyncio.sleep(embed_ms / 1000.0)
+                    out = {"embeddings": [[0.3] * 8 for _ in texts], "dim": 8}
+                    embedded[0] += len(texts)
+                else:
+                    await asyncio.sleep(0.001)
+                    out = {"ok": True}
+                ptr = await mem.put_result(req.job_id, out)
+                await bus.publish(subj.RESULT, BusPacket.wrap(
+                    JobResult(
+                        job_id=req.job_id, status="SUCCEEDED",
+                        result_ptr=ptr, worker_id=wid,
+                        execution_ms=int((time.perf_counter() - t0) * 1000),
+                    ),
+                    trace_id=pkt.trace_id, sender_id=wid))
+            return handler
+
+        await bus.subscribe(subj.direct_subject(wid), make_handler(), queue=wid)
+
+    # -- workflow plane: engine + queue-group result consumer + reconciler,
+    # context steps in-engine with embeds dispatched back to the pool
+    embedder = BusEmbedder(bus, mem, timeout_s=30.0)
+    ctx_svc = ContextService(kv, embedder=embedder)
+    wf_store = WorkflowStore(kv)
+    wf_metrics = Metrics()
+    wf_engine = WfEngine(store=wf_store, bus=bus, mem=mem, metrics=wf_metrics,
+                         instance_id="agents-wf", context_svc=ctx_svc)
+    wf_svc = WorkflowEngineService(engine=wf_engine, bus=bus, job_store=js,
+                                   instance_id="agents-wf",
+                                   reconcile_interval_s=0.5)
+    await wf_svc.start()
+
+    # gateway-equivalent admission at run start (tier 0 without fleet
+    # pressure — the run still pays the controller's book-keeping path)
+    controller = AdmissionController(
+        fleet=FleetAggregator(bus, metrics=Metrics()),
+        config={"enabled": True, "queue_depth_limit": 10_000,
+                "tenants": {"default": {"rate_rps": 0, "burst": 0}}},
+        metrics=Metrics(), instance_id="agents-gw",
+    )
+
+    # the 4-step agent loop: generate → remember (context.update, embeds its
+    # note chunk) → window (context.window RAG, embeds the query) → generate
+    # with the window output in scope
+    await wf_store.put_workflow(Workflow.from_dict({
+        "id": "agent-loop",
+        "slo_class": "INTERACTIVE",
+        "steps": {
+            "plan": {"topic": "job.tpu.generate",
+                     "input": {"op": "llm.generate",
+                               "prompt": "${input.goal}"}},
+            "remember": {"topic": "job.tpu.context",
+                         "depends_on": ["plan"],
+                         "input": {"op": "context.update",
+                                   "user_payload": "${input.goal}",
+                                   "model_response": "${steps.plan.text}",
+                                   "chunks": [{"file_path": "notes",
+                                               "content": "${steps.plan.text}"}]}},
+            "window": {"topic": "job.tpu.context",
+                       "depends_on": ["remember"],
+                       "input": {"op": "context.window", "mode": "RAG",
+                                 "query": "${input.goal}"}},
+            "act": {"topic": "job.tpu.generate",
+                    "depends_on": ["window"],
+                    "input": {"op": "llm.generate",
+                              "prompt": "ctx ${steps.window.message_count}: "
+                                        "${steps.plan.text}"}},
+        },
+    }))
+
+    run_ids: list[str] = []
+    shed = [0]
+
+    async def start_agent_turn(spec, session_id, turn) -> None:
+        verdict = controller.admit(op="workflow.run",
+                                   job_class="INTERACTIVE", tenant="default")
+        if not verdict.allowed:
+            shed[0] += 1
+            return
+        run = await wf_engine.start_run(
+            "agent-loop", {"goal": f"goal {session_id} t{turn}"},
+            org_id="default",
+            # every turn of one agent shares the session key (and thus the
+            # memory + the serving worker): turn N resumes where N-1 left off
+            labels={LABEL_SESSION_KEY: f"agent-{session_id}"},
+        )
+        run_ids.append(run.run_id)
+
+    duration_s = 3.5 if smoke else 8.0
+    rate = 6.0 if smoke else 25.0
+    tenants = [TenantSpec(name="agents", job_class="INTERACTIVE",
+                          op="llm.generate", rate_rps=rate,
+                          session_turns=2, think_time_s=0.3)]
+    gen = LoadGen(start_agent_turn, tenants, duration_s=duration_s)
+    t_start = time.perf_counter()
+    await gen.run()
+
+    # settle: drive the pipeline until every started run is terminal
+    deadline = time.perf_counter() + (10.0 if smoke else 20.0)
+    terminal = set(WM.RUN_TERMINAL)
+    runs = []
+    while time.perf_counter() < deadline:
+        await bus.drain()
+        await wf_engine.drain_context_steps()
+        runs = await wf_store.get_runs(run_ids)
+        if runs and all(r is not None and r.status in terminal for r in runs):
+            break
+        await asyncio.sleep(0.05)
+    wall = time.perf_counter() - t_start
+
+    await wf_svc.stop()
+    await embedder.stop()
+    await sched.stop()
+    await bus.close()
+
+    step_ms: list[float] = []
+    steps_done = 0
+    runs_ok = runs_failed = 0
+    for r in runs:
+        if r is None:
+            continue
+        if r.status == WM.SUCCEEDED:
+            runs_ok += 1
+        elif r.status in terminal:
+            runs_failed += 1
+        for sr in r.steps.values():
+            if sr.status == WM.SUCCEEDED:
+                steps_done += 1
+                if sr.finished_at_us and sr.started_at_us:
+                    step_ms.append((sr.finished_at_us - sr.started_at_us) / 1e3)
+
+    def p(q: float, vals: list) -> float:
+        if not vals:
+            return 0.0
+        s = sorted(vals)
+        return s[min(len(s) - 1, int(q * (len(s) - 1)))]
+
+    # strategy counters: the first route of a session is "new" (neither hit
+    # nor miss), so hits/(hits+misses) IS the steady-state affinity rate
+    hits, misses = strategy.session_affinity_hits, strategy.session_affinity_misses
+    sessions = len(session_workers)
+    steady = hits + misses
+    reprefills = sum(len(ws) - 1 for ws in session_workers.values() if len(ws) > 1)
+    return {
+        "agents_workflow_steps_per_sec": round(steps_done / wall, 1) if wall else 0.0,
+        "agents_step_p50_ms": round(p(0.50, step_ms), 2),
+        "agents_step_p99_ms": round(p(0.99, step_ms), 2),
+        "agents_steps_completed": steps_done,
+        "agents_runs_started": len(run_ids),
+        "agents_runs_completed": runs_ok,
+        "agents_runs_failed": runs_failed,
+        "agents_runs_shed": shed[0],
+        "agents_sessions": sessions,
+        "agents_affinity_hit_rate": round(hits / steady, 4) if steady else 1.0,
+        "agents_affinity_hits": hits,
+        "agents_affinity_misses": misses,
+        "agents_reprefills": reprefills,
+        "agents_prefills": prefills[0],
+        "agents_context_embeds": embedded[0],
+        "agents_context_embeds_per_sec": round(embedded[0] / wall, 1) if wall else 0.0,
+        "agents_context_embed_jobs": embedder.jobs_total,
+    }
+
+
 _CHILD_METRIC_KEYS = (
     "embeds_per_sec", "model_tokens_per_sec", "model_achieved_tflops",
     "model_params_m", "single_job_embeds_per_sec", "batched_embeds_per_sec",
@@ -2311,6 +2571,10 @@ def bench_jax(*, smoke: bool = False) -> dict:
 
 def main() -> None:
     global N_JOBS, PACED_JOBS, PACED_RATE, JAX_TIMEOUT_S
+    # hermetic placement: the bench itself saturates the host, and real
+    # loadavg-derived cpu_load would flip its in-process workers to
+    # overloaded (breaking the affinity-hit floors it gates on)
+    os.environ.setdefault("CORDUM_HOST_LOAD", "0")
     if len(sys.argv) >= 2 and sys.argv[1] == "--jax-child":
         _jax_child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
         return
@@ -2351,6 +2615,17 @@ def main() -> None:
         out = {"metric": "storm_interactive_p99_ms", "unit": "ms"}
         out.update(asyncio.run(bench_storm(smoke="--smoke" in sys.argv)))
         out["value"] = out["storm_interactive_p99_ms"]
+        print(json.dumps(out))
+        return
+    if "--agents" in sys.argv:
+        # agent-workflow mode (ISSUE 17): the agent-loop storm — concurrent
+        # multi-step workflows with think time through admission → affinity-
+        # routed serving → context embeds on the pool → workflow resume.
+        # One JSON line, same agents_* keys as the full bench so
+        # bench_floor.json gates both surfaces.
+        out = {"metric": "agents_workflow_steps_per_sec", "unit": "steps/s"}
+        out.update(asyncio.run(bench_agents(smoke="--smoke" in sys.argv)))
+        out["value"] = out["agents_workflow_steps_per_sec"]
         print(json.dumps(out))
         return
     if "--serving" in sys.argv:
@@ -2403,6 +2678,7 @@ def main() -> None:
     prof = bench_profile() if profile else None
     affinity = bench_session_affinity()
     storm = asyncio.run(bench_storm(smoke=smoke))
+    agents = asyncio.run(bench_agents(smoke=smoke))
     gang = bench_gang(smoke=smoke)
     jx = bench_jax(smoke=smoke)
     out = {
@@ -2516,6 +2792,12 @@ def main() -> None:
         # batch absorbs the shedding, and the admission-disabled control
         # run degrades (floors/ceilings in bench_floor.json)
         **storm,
+        # agentic workflow serving (ISSUE 17): the agent-loop storm —
+        # session-carrying DAG steps through admission, session-affinity
+        # serving, pool-executed context embeds, and workflow resume
+        # (steps/s + hit-rate floors, step-p99 + re-prefill ceilings in
+        # bench_floor.json)
+        **agents,
         # gang scheduling (ISSUE 15): barrier-only gang rate + the three
         # MULTICHIP flows as scheduled gang jobs (gang_jobs_per_sec /
         # gang_flows_ok floors + the gang_partial_reservations == 0

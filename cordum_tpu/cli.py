@@ -204,7 +204,11 @@ def cmd_run(args) -> None:
         elif args.action == "status":
             _print(_check(c.get(f"/api/v1/runs/{args.run_id}")))
         elif args.action == "timeline":
-            _print(_check(c.get(f"/api/v1/runs/{args.run_id}/timeline")))
+            doc = _check(c.get(f"/api/v1/runs/{args.run_id}/timeline"))
+            if getattr(args, "json", False):
+                _print(doc)
+            else:
+                print(_render_run_timeline(doc.get("timeline") or []))
         elif args.action == "cancel":
             _print(_check(c.post(f"/api/v1/runs/{args.run_id}/cancel")))
         elif args.action == "approve-step":
@@ -216,6 +220,58 @@ def cmd_run(args) -> None:
                                  json={"from_step": args.step_id})))
         elif args.action == "list":
             _print(_check(c.get("/api/v1/runs")))
+
+
+def _render_run_timeline(events: list[dict]) -> str:
+    """Human-readable run timeline: +offset from run start, step, event,
+    detail.  The raw event list stays available behind --json."""
+    if not events:
+        return "no timeline events"
+    t0 = min(int(e.get("ts_us", 0) or 0) for e in events)
+    lines = []
+    for e in events:
+        dt_ms = (int(e.get("ts_us", 0) or 0) - t0) / 1000.0
+        step = str(e.get("step_id", "") or "-")
+        lines.append(
+            f"+{dt_ms:9.1f}ms  {step:<24} {str(e.get('event', '')):<20} "
+            f"{str(e.get('detail', ''))}"
+        )
+    return "\n".join(lines)
+
+
+def cmd_runs(args) -> None:
+    """Workflow-run fleet table (GET /api/v1/runs?detail=1): one row per run
+    with status, SLO class, step progress, and duration."""
+    q = f"?detail=1&workflow_id={args.workflow_id}" if args.workflow_id else "?detail=1"
+    with _client() as c:
+        doc = _check(c.get(f"/api/v1/runs{q}"))
+    runs = doc.get("runs") or []
+    if args.json:
+        _print(runs)
+        return
+    if not runs:
+        print("no runs")
+        return
+    cols = ["run_id", "workflow", "status", "slo", "steps", "duration_s", "trace_id"]
+    rows = []
+    for r in runs:
+        steps = r.get("steps") or {}
+        done = sum(1 for s in steps.values() if s == "SUCCEEDED")
+        t0, t1 = int(r.get("created_at_us") or 0), int(r.get("finished_at_us") or 0)
+        dur = f"{(t1 - t0) / 1e6:.2f}" if t1 and t0 else ""
+        rows.append({
+            "run_id": str(r.get("run_id", "")),
+            "workflow": str(r.get("workflow_id", "")),
+            "status": str(r.get("status", "")),
+            "slo": str(r.get("slo_class", "") or "-"),
+            "steps": f"{done}/{len(steps)}",
+            "duration_s": dur,
+            "trace_id": str(r.get("trace_id", "")),
+        })
+    widths = {c_: max(len(c_), *(len(r[c_]) for r in rows)) for c_ in cols}
+    print("  ".join(c_.ljust(widths[c_]) for c_ in cols))
+    for r in rows:
+        print("  ".join(r[c_].ljust(widths[c_]) for c_ in cols))
 
 
 def _wait_run(c: httpx.Client, run_id: str, timeout_s: float = 300.0) -> None:
@@ -517,7 +573,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--reject", action="store_true")
     sp.add_argument("--dry-run", dest="dry_run", action="store_true")
     sp.add_argument("--wait", action="store_true")
+    sp.add_argument("--json", action="store_true",
+                    help="timeline: raw JSON instead of the rendered view")
     sp.set_defaults(fn=cmd_run)
+
+    sp = sub.add_parser("runs", help="workflow-run fleet table")
+    sp.add_argument("--workflow-id", dest="workflow_id", default="")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_runs)
 
     sp = sub.add_parser("approval")
     sp.add_argument("action", choices=["list", "approve", "reject"])

@@ -12,6 +12,14 @@ from ..infra.config import Config, load
 
 def setup() -> Config:
     logx.setup()
+    # SIGUSR1 dumps all thread stacks to stderr — the only way to see where
+    # a service binary is stuck without restarting it under a debugger
+    try:
+        import faulthandler
+
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
+    except (AttributeError, ValueError):  # pragma: no cover - non-POSIX
+        pass
     return load()
 
 

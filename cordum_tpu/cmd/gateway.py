@@ -8,7 +8,7 @@ from __future__ import annotations
 import asyncio
 import os
 
-from ..context.service import ContextService
+from ..context.service import BusEmbedder, ContextService
 from ..controlplane.gateway.app import Gateway
 from ..controlplane.gateway.auth import BasicAuthProvider
 from ..controlplane.safetykernel.kernel import SafetyKernel
@@ -31,8 +31,17 @@ async def main() -> None:
     schemas = SchemaRegistry(kv)
     mem = MemoryStore(kv)
     wf_store = WorkflowStore(kv)
+    # context.* workflow steps run in-engine; their embeds ride the worker
+    # pool as micro-batched embed jobs (BusEmbedder, docs/WORKFLOWS.md)
+    context_svc = ContextService(kv, embedder=BusEmbedder(bus, mem))
+    from ..infra.metrics import Metrics
+
+    # the embedded engine shares the gateway's registry so cordum_workflow_*
+    # families land on the same /metrics surface
+    metrics = Metrics()
     wf_engine = WorkflowEngine(store=wf_store, bus=bus, mem=mem, schemas=schemas,
-                               configsvc=configsvc, instance_id="gateway-wf")
+                               configsvc=configsvc, instance_id="gateway-wf",
+                               metrics=metrics, context_svc=context_svc)
     # SLO objectives + admission-control config come from the pools.yaml
     # slo:/admission: stanzas; an unreadable pool file must not stop the
     # gateway (it just runs without burn tracking or load shedding)
@@ -57,9 +66,9 @@ async def main() -> None:
         if sep and k and t:
             key_tenants[k] = t
     gw = Gateway(
-        kv=kv, bus=bus, job_store=JobStore(kv), mem=mem, kernel=kernel,
+        kv=kv, bus=bus, job_store=JobStore(kv), mem=mem, kernel=kernel, metrics=metrics,
         wf_store=wf_store, wf_engine=wf_engine, schemas=schemas, configsvc=configsvc,
-        registry=WorkerRegistry(), context_svc=ContextService(kv),
+        registry=WorkerRegistry(), context_svc=context_svc,
         auth=BasicAuthProvider(
             cfg.api_keys, admin_keys=admin_keys,
             default_tenant=os.environ.get("CORDUM_DEFAULT_TENANT", "default"),

@@ -15,6 +15,7 @@ Token budget trimming keeps the reference's 4-chars≈1-token estimate.
 """
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 from dataclasses import dataclass, field
@@ -23,6 +24,16 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from ..infra.kv import KV
+from ..protocol import subjects as subj
+from ..protocol.types import (
+    BusPacket,
+    JobRequest,
+    JobState,
+    LABEL_BATCH_KEY,
+    LABEL_OP,
+    TERMINAL_STATES,
+)
+from ..utils.ids import new_id
 
 HISTORY_WINDOW = 20  # last-N chat events (reference service.go:55-132)
 HISTORY_CAP = 500
@@ -137,6 +148,120 @@ class PoolEmbedder(EmbedFn):
         return np.concatenate(parts, axis=0)
 
 
+class BusEmbedder(EmbedFn):
+    """Async EmbedFn that runs embeds on the TPU worker pool over the bus.
+
+    The engine-side counterpart of :class:`PoolEmbedder`: same per-job
+    slicing, but bus-native and non-blocking, so it is safe to await from
+    inside the control plane's event loop (``context.*`` workflow steps —
+    PoolEmbedder's synchronous polling would deadlock there, since the
+    results it waits for are produced by the same loop).  Each slice is a
+    normal JobRequest on ``sys.job.submit`` stamped with the batch-affinity
+    labels, so the scheduler coalesces concurrent slices onto one worker's
+    micro-batcher exactly like gateway-submitted embeds
+    (docs/BATCHING.md)."""
+
+    def __init__(
+        self,
+        bus: Any,
+        mem: Any,
+        *,
+        topic: str = "job.tpu.embed",
+        texts_per_job: int = 16,
+        timeout_s: float = 60.0,
+        tenant_id: str = "",
+    ) -> None:
+        self.bus = bus
+        self.mem = mem  # MemoryStore: pointers in, pointers out
+        self.topic = topic
+        self.texts_per_job = max(1, texts_per_job)
+        self.timeout_s = timeout_s
+        self.tenant_id = tenant_id
+        self.embeds_total = 0  # texts embedded (bench: context_embeds_per_sec)
+        self.jobs_total = 0
+        self._pending: dict[str, asyncio.Future] = {}
+        self._subs: list = []
+
+    async def start(self) -> None:
+        """Plain (non-queue-group) result subscriptions: see every result
+        broadcast alongside the scheduler/engine queue groups, filter by
+        our own job ids.  Both the plain subject and the partition-stamped
+        ``sys.job.result.<p>`` variants are covered — under a sharded
+        scheduler the worker echoes the owning shard's partition, so the
+        embed results never ride the plain subject.  Lazy — first
+        ``aembed`` call attaches them."""
+        if not self._subs:
+            self._subs.append(await self.bus.subscribe(subj.RESULT, self._on_result))
+            self._subs.append(
+                await self.bus.subscribe(f"{subj.RESULT}.>", self._on_result)
+            )
+
+    async def stop(self) -> None:
+        for s in self._subs:
+            s.unsubscribe()
+        self._subs = []
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
+
+    async def _on_result(self, subject: str, pkt: BusPacket) -> None:
+        res = pkt.job_result
+        if res is None or res.job_id not in self._pending:
+            return
+        if res.status not in (s.value for s in TERMINAL_STATES):
+            return  # RUNNING hint; keep waiting for the terminal state
+        fut = self._pending.pop(res.job_id)
+        if not fut.done():
+            fut.set_result(res)
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:  # pragma: no cover
+        raise RuntimeError("BusEmbedder is async-only; await aembed(texts)")
+
+    async def aembed(self, texts: Sequence[str]) -> np.ndarray:
+        await self.start()
+        loop = asyncio.get_running_loop()
+        job_ids: list[str] = []
+        futs: list[asyncio.Future] = []
+        for i in range(0, len(texts), self.texts_per_job):
+            job_id = f"ctxembed-{new_id()}"
+            ptr = await self.mem.put_context(
+                job_id, {"op": "embed", "texts": list(texts[i:i + self.texts_per_job])}
+            )
+            fut = loop.create_future()
+            self._pending[job_id] = fut
+            req = JobRequest(
+                job_id=job_id,
+                topic=self.topic,
+                context_ptr=ptr,
+                tenant_id=self.tenant_id,
+                labels={LABEL_OP: "embed", LABEL_BATCH_KEY: "embed"},
+            )
+            await self.bus.publish(
+                subj.SUBMIT, BusPacket.wrap(req, sender_id="bus-embedder")
+            )
+            job_ids.append(job_id)
+            futs.append(fut)
+        try:
+            results = await asyncio.wait_for(asyncio.gather(*futs), self.timeout_s)
+        finally:
+            for jid in job_ids:
+                self._pending.pop(jid, None)
+        parts: list[np.ndarray] = []
+        for jid, res in zip(job_ids, results):
+            if res.status != JobState.SUCCEEDED.value:
+                raise RuntimeError(
+                    f"embed job {jid} reached {res.status}: {res.error_message}"
+                )
+            out = await self.mem.get_pointer(res.result_ptr)
+            if not out or "embeddings" not in out:
+                raise RuntimeError(f"embed job {jid} result missing embeddings")
+            parts.append(np.asarray(out["embeddings"], np.float32))
+        self.embeds_total += len(texts)
+        self.jobs_total += len(job_ids)
+        return np.concatenate(parts, axis=0)
+
+
 class ContextService:
     def __init__(
         self,
@@ -159,6 +284,19 @@ class ContextService:
         a few pool jobs (PoolEmbedder) instead of one unbounded call."""
         parts = [
             np.asarray(self.embedder.embed(texts[i:i + self.embed_batch]))
+            for i in range(0, len(texts), self.embed_batch)
+        ]
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+
+    async def _aembed_texts(self, texts: list[str]) -> np.ndarray:
+        """Async twin of ``_embed_texts``: awaits an ``aembed``-capable
+        embedder (BusEmbedder — pool jobs without blocking the event loop);
+        sync embedders run inline as before."""
+        aembed = getattr(self.embedder, "aembed", None)
+        if aembed is None:
+            return self._embed_texts(texts)
+        parts = [
+            np.asarray(await aembed(texts[i:i + self.embed_batch]))
             for i in range(0, len(texts), self.embed_batch)
         ]
         return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
@@ -196,7 +334,7 @@ class ContextService:
             if await self.kv.get(_embed_key(memory_id, h)) is None:
                 missing.append((h, _chunk_text(c)))
         if missing:
-            vecs = self._embed_texts([t for _, t in missing])
+            vecs = await self._aembed_texts([t for _, t in missing])
             for (h, _), v in zip(missing, np.asarray(vecs)):
                 await self.kv.set(
                     _embed_key(memory_id, h), np.asarray(v, np.float32).tobytes()
@@ -254,7 +392,7 @@ class ContextService:
         if not chunks:
             return []
         if self.embedder is not None and query:
-            qv = np.asarray(self.embedder.embed([query]))[0]
+            qv = np.asarray(await self._aembed_texts([query]))[0]
             scored = []
             to_embed: list[tuple[int, str]] = []
             vecs: dict[int, np.ndarray] = {}
@@ -265,7 +403,7 @@ class ContextService:
                 else:
                     to_embed.append((i, _chunk_text(c)))
             if to_embed:
-                new_vecs = np.asarray(self._embed_texts([t for _, t in to_embed]))
+                new_vecs = np.asarray(await self._aembed_texts([t for _, t in to_embed]))
                 for (i, _), v in zip(to_embed, new_vecs):
                     vecs[i] = np.asarray(v, np.float32)
                     await self.kv.set(

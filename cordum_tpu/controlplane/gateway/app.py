@@ -70,6 +70,7 @@ from ...protocol.types import (
     LABEL_OP,
     LABEL_SECRETS_PRESENT,
     LABEL_SESSION_KEY,
+    LABEL_SLO_CLASS,
     PolicyCheckRequest,
     TERMINAL_STATES,
     WorkerDrain,
@@ -836,19 +837,59 @@ class Gateway:
             # body org_id may not escape the key's tenant scope (same class
             # as the submit_job tenant guard)
             return _err(403, f"org {org!r} not permitted for this principal")
+        labels = {str(k): str(v) for k, v in (body.get("labels") or {}).items()}
+        wf = await self.wf_store.get_workflow(wf_id)
+        if wf is None:
+            return _err(404, "unknown workflow")
+        # a run rides the admission ladder like a job: its SLO class (per-run
+        # label override > workflow default) is the job class every dispatched
+        # step inherits, so shedding happens before any step is scheduled
+        slo = str(labels.get(LABEL_SLO_CLASS) or wf.slo_class or "").upper()
+        if slo:
+            labels[LABEL_SLO_CLASS] = slo
+        verdict = self.admission.admit(
+            op="workflow.run", job_class=slo or "BATCH", tenant=org
+        )
+        if not verdict.allowed:
+            doc = {
+                "error": f"shed: {verdict.reason}",
+                "reason": verdict.reason,
+                "retry_after_s": verdict.retry_after_s,
+            }
+            return web.json_response(
+                doc, status=429, headers=_retry_after_headers(429, doc))
         run = await self.wf_engine.start_run(
             wf_id,
             body.get("input"),
             org_id=org,
             idempotency_key=request.headers.get("Idempotency-Key", str(body.get("idempotency_key", ""))),
             dry_run=bool(body.get("dry_run", False)),
-            labels={str(k): str(v) for k, v in (body.get("labels") or {}).items()},
+            labels=labels,
             max_concurrent_runs=self.max_concurrent_runs,
         )
         return web.json_response({"run_id": run.run_id, "status": run.status}, status=202)
 
     async def list_runs(self, request: web.Request) -> web.Response:
         ids = await self.wf_store.list_runs(request.query.get("workflow_id", ""))
+        if request.query.get("detail") in ("1", "true"):
+            # summary docs in one batched fetch (cordumctl runs table)
+            runs = await self.wf_store.get_runs(ids)
+            docs = [
+                {
+                    "run_id": r.run_id,
+                    "workflow_id": r.workflow_id,
+                    "status": r.status,
+                    "org_id": r.org_id,
+                    "slo_class": r.labels.get(LABEL_SLO_CLASS, ""),
+                    "trace_id": r.trace_id,
+                    "created_at_us": r.created_at_us,
+                    "finished_at_us": r.finished_at_us,
+                    "steps": {k: sr.status for k, sr in r.steps.items()},
+                }
+                for r in runs
+                if r is not None
+            ]
+            return web.json_response({"runs": docs})
         return web.json_response({"runs": ids})
 
     async def get_run(self, request: web.Request) -> web.Response:

@@ -11,15 +11,23 @@ results the service missed (crash between worker publish and engine apply).
 from __future__ import annotations
 
 import asyncio
+import random
+import time
 from typing import Optional
 
 from ...infra import logging as logx
-from ...infra.bus import Bus, RetryAfter
+from ...infra.bus import Bus, MAX_NAK_DELAY_S, RetryAfter
 from ...infra.jobstore import JobStore
 from ...protocol import subjects as subj
 from ...protocol.types import BusPacket, JobResult, JobState, TERMINAL_STATES
 from ...workflow import models as M
 from ...workflow.engine import Engine as WorkflowEngine, split_job_id
+
+# base NAK delay for run-lock contention; doubles per redelivery with ±25 %
+# jitter, capped at MAX_NAK_DELAY_S (the scheduler's tenant-NAK convention,
+# docs/PROTOCOL.md §Subjects) — two replicas converging on one hot run
+# de-synchronize instead of retrying in lockstep
+RUN_LOCK_NAK_BASE_S = 0.05
 
 
 class WorkflowEngineService:
@@ -47,6 +55,23 @@ class WorkflowEngineService:
                 subj.RESULT, self._on_result, queue=subj.QUEUE_WORKFLOW_ENGINE
             )
         )
+        # under a sharded scheduler, workers echo the owning shard's
+        # partition and publish on ``sys.job.result.<p>`` — without this
+        # wildcard the engine would only advance runs via the reconciler's
+        # JobStore replay (one reconcile interval of latency per step)
+        self._subs.append(
+            await self.bus.subscribe(
+                f"{subj.RESULT}.>", self._on_result, queue=subj.QUEUE_WORKFLOW_ENGINE
+            )
+        )
+        # context.* steps executed in-engine report on their own subject
+        # (the scheduler must not see jobs it never dispatched); same queue
+        # group, so any replica applies them under the run lock
+        self._subs.append(
+            await self.bus.subscribe(
+                subj.STEP_RESULT, self._on_result, queue=subj.QUEUE_WORKFLOW_ENGINE
+            )
+        )
         self._stop.clear()
         self._task = asyncio.ensure_future(self._reconcile_loop())
 
@@ -66,15 +91,20 @@ class WorkflowEngineService:
         res = pkt.job_result
         if res is None or not res.job_id:
             return
-        await self.handle_job_result(res)
+        await self.handle_job_result(res, redeliveries=pkt.redelivery_count)
 
-    async def handle_job_result(self, res: JobResult) -> None:
+    async def handle_job_result(self, res: JobResult, *, redeliveries: int = 0) -> None:
         try:
             run_id, _, _ = split_job_id(res.job_id)
         except ValueError:
             return  # not a workflow job
         if not await self.engine.store.acquire_run_lock(run_id, self.instance_id):
-            raise RetryAfter(0.05, f"run {run_id} locked")
+            delay = min(
+                MAX_NAK_DELAY_S,
+                RUN_LOCK_NAK_BASE_S * (2 ** max(0, redeliveries)),
+            )
+            delay *= 1.0 + random.uniform(-0.25, 0.25)
+            raise RetryAfter(delay, f"run {run_id} locked")
         try:
             await self.engine.handle_job_result(res)
         finally:
@@ -93,22 +123,37 @@ class WorkflowEngineService:
                 pass
 
     async def reconcile_once(self) -> int:
-        """Resume due waits and replay missed terminal job states."""
+        """Resume due waits and replay missed terminal job states.
+
+        The per-pass scan is batched: all status indexes are read in one
+        concurrent zrange batch, and runs whose lock is already held are
+        skipped off a single lock-prefix scan instead of paying a setnx
+        round trip per busy run.  Pass cost lands in
+        ``cordum_workflow_reconcile_seconds``; the live-run count feeds
+        ``cordum_workflow_active_runs``."""
+        t0 = time.monotonic()
         progressed = 0
-        for status in (M.PENDING, M.RUNNING, M.WAITING):
-            for run_id in await self.engine.store.list_run_ids_by_status(status):
-                if not await self.engine.store.acquire_run_lock(run_id, self.instance_id):
-                    continue
-                try:
-                    if await self.engine.resume_due(run_id):
-                        progressed += 1
-                    if self.job_store is not None:
-                        progressed += await self._replay_terminal_jobs(run_id)
-                except Exception:
-                    # one poisoned run must not starve the rest of the pass
-                    logx.error("reconcile failed for run", run_id=run_id)
-                finally:
-                    await self.engine.store.release_run_lock(run_id, self.instance_id)
+        store = self.engine.store
+        rows = await store.list_run_ids_by_statuses((M.PENDING, M.RUNNING, M.WAITING))
+        metrics = self.engine.metrics
+        metrics.workflow_active_runs.set(float(len({rid for _, rid in rows})))
+        held = await store.held_run_locks() if rows else set()
+        for _status, run_id in rows:
+            if run_id in held:
+                continue  # busy under another replica; next pass retries
+            if not await store.acquire_run_lock(run_id, self.instance_id):
+                continue  # lost a race since the prefix scan
+            try:
+                if await self.engine.resume_due(run_id):
+                    progressed += 1
+                if self.job_store is not None:
+                    progressed += await self._replay_terminal_jobs(run_id)
+            except Exception:
+                # one poisoned run must not starve the rest of the pass
+                logx.error("reconcile failed for run", run_id=run_id)
+            finally:
+                await store.release_run_lock(run_id, self.instance_id)
+        metrics.workflow_reconcile_seconds.observe(time.monotonic() - t0)
         return progressed
 
     async def _replay_terminal_jobs(self, run_id: str) -> int:
@@ -125,11 +170,20 @@ class WorkflowEngineService:
                 meta = await self.job_store.get_meta(t.job_id)
                 state = meta.get("state", "")
                 if state and state in (s.value for s in TERMINAL_STATES):
+                    try:
+                        execution_ms = int(meta.get("execution_ms", "0") or 0)
+                    except ValueError:
+                        execution_ms = 0
+                    # the replay mirrors every JobResult field the live path
+                    # persists (scheduler _result_fields); result labels are
+                    # transport-only stream metadata the engine never reads,
+                    # so the synthesized result carries the wire default
                     res = JobResult(
                         job_id=t.job_id,
                         status=state,
                         result_ptr=meta.get("result_ptr", ""),
                         worker_id=meta.get("worker_id", ""),
+                        execution_ms=execution_ms,
                         error_code=meta.get("error_code", ""),
                         error_message=meta.get("error_message", ""),
                     )

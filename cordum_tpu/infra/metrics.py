@@ -298,6 +298,26 @@ class Metrics:
         )
         self.policy_evals = Counter("cordum_policy_evals_total", "Safety kernel evaluations")
         self.workflow_steps = Counter("cordum_workflow_steps_total", "Workflow steps dispatched")
+        # agentic workflow plane (docs/WORKFLOWS.md): run starts/terminals
+        # (status=STARTED|SUCCEEDED|FAILED|CANCELLED), per-step wall-clock
+        # latency (dispatch → terminal result, run trace as exemplar), live
+        # non-terminal runs (set by the reconciler's status-index sweep),
+        # and the reconciler pass cost itself
+        self.workflow_runs = Counter(
+            "cordum_workflow_runs_total", "Workflow runs started / finished by status"
+        )
+        self.workflow_step_seconds = Histogram(
+            "cordum_workflow_step_seconds",
+            "Workflow step latency: dispatch to terminal result",
+            buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+        )
+        self.workflow_active_runs = Gauge(
+            "cordum_workflow_active_runs", "Runs in a non-terminal status"
+        )
+        self.workflow_reconcile_seconds = Histogram(
+            "cordum_workflow_reconcile_seconds",
+            "Workflow reconciler pass duration",
+        )
         self.workers_live = Gauge("cordum_workers_live", "Live workers in registry")
         self.tpu_duty_cycle = Gauge("cordum_tpu_duty_cycle", "Reported TPU duty cycle per worker")
         # micro-batching (cordum_tpu/batching): rows-per-flush distribution,
@@ -608,6 +628,10 @@ class Metrics:
             self.spans_collected,
             self.policy_evals,
             self.workflow_steps,
+            self.workflow_runs,
+            self.workflow_step_seconds,
+            self.workflow_active_runs,
+            self.workflow_reconcile_seconds,
             self.workers_live,
             self.tpu_duty_cycle,
             self.batch_size,

@@ -1193,6 +1193,15 @@ def _route_key(key: str) -> str:
     for p in _ID_ROUTED_PREFIXES:
         if key.startswith(p):
             return key[len(p):] or key
+    # workflow state co-locates on ONE partition: WorkflowStore.put_run is a
+    # single pipelined commit over the run blob + shared z-indexes
+    # (wf:run:index / wf:run:status:* / wf:run:org_active:*), and a pipe
+    # executes on one partition — so the index reads (reconciler status
+    # scans, run listings) must route to the same partition the pipe wrote.
+    # Workflow traffic is control-plane-light relative to job state, so the
+    # lost spread is noise.
+    if key.startswith("wf:"):
+        return "wf:"
     return key
 
 

@@ -8,6 +8,13 @@ PROGRESS = "sys.job.progress"
 CANCEL = "sys.job.cancel"
 DLQ = "sys.job.dlq"
 WORKFLOW_EVENT = "sys.workflow.event"
+# workflow-internal step results (``context.*`` steps executed in-engine,
+# docs/WORKFLOWS.md §Context engine): the same JobResult payloads as RESULT,
+# but on a subject the scheduler does NOT consume — the jobstore never saw
+# these jobs, so riding ``sys.job.result`` would log an illegal-transition
+# error per context step.  The workflow-engine queue group consumes it, so
+# any replica may apply the result under the run lock.
+STEP_RESULT = "sys.workflow.step.result"
 # graceful worker drain (docs/SERVING.md §Migration, drain, and failover):
 # fan-out — every worker hears it and the addressed one drains.  Not
 # durable: a drain request is an operator action, re-issued if lost.
@@ -125,7 +132,9 @@ def is_durable_subject(subject: str) -> bool:
     TRACE_SPAN added so a bus blip cannot silently hole a trace; the
     partitioned ``sys.job.submit.<p>``/``result.<p>``/``cancel.<p>``
     variants inherit their parents' durability)."""
-    if subject in (SUBMIT, RESULT, DLQ, TRACE_SPAN):
+    if subject in (SUBMIT, RESULT, DLQ, TRACE_SPAN, STEP_RESULT):
+        # STEP_RESULT is durable: a dropped context-step result would strand
+        # its run in RUNNING (these jobs have no jobstore state to replay)
         return True
     for parent in (SUBMIT, RESULT, CANCEL):
         if subject.startswith(parent + "."):

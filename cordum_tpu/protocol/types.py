@@ -865,6 +865,11 @@ LABEL_APPROVAL_REF = "approval_ref"
 LABEL_BUS_MSG_ID = "cordum.bus_msg_id"
 LABEL_DRY_RUN = "cordum.dry_run"
 LABEL_SECRETS_PRESENT = "secrets_present"
+# Workflow SLO class (docs/WORKFLOWS.md): stamped on the run at start (from
+# Workflow.slo_class or a per-run label override) and propagated by the
+# engine into every dispatched JobRequest.priority, so agent-loop steps ride
+# the admission ladder and the class-split e2e histogram like API submits.
+LABEL_SLO_CLASS = "cordum.slo_class"
 ENV_EFFECTIVE_CONFIG = "CORDUM_EFFECTIVE_CONFIG"
 
 # ---------------------------------------------------------------------------

@@ -1173,6 +1173,7 @@ class Worker:
             pool=self.pool,
             labels=labels,
             draining=self._draining,
+            cpu_load=_host_cpu_load(),
             tpu_duty_cycle=self._duty_cycle(),
             hbm_used_gb=hbm_used,
             hbm_total_gb=hbm_total,
@@ -1194,6 +1195,24 @@ class Worker:
                 await self.send_heartbeat()
             except Exception:
                 logx.warn("heartbeat publish failed", worker_id=self.worker_id)
+
+
+def _host_cpu_load() -> float:
+    """Host CPU pressure as a 0-100 %: 1-minute load average normalized by
+    core count.  The least-loaded strategy folds it into the worker score
+    (strategy.py load_score) and treats ≥90 as overloaded — so workers
+    sharing a host with unrelated heavy processes stop winning placement.
+    CORDUM_HOST_LOAD=0 disables it (hermetic tests: the suite itself
+    saturates single-core CI hosts, which must not flip every worker to
+    overloaded)."""
+    import os
+
+    if os.environ.get("CORDUM_HOST_LOAD", "1") == "0":
+        return 0.0
+    try:
+        return min(100.0, 100.0 * os.getloadavg()[0] / (os.cpu_count() or 1))
+    except (OSError, AttributeError):  # pragma: no cover - non-POSIX
+        return 0.0
 
 
 def _device_telemetry() -> dict:

@@ -20,6 +20,7 @@ Recreates the reference engine's behavior (``core/workflow/engine.go``,
 """
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 from typing import Any, Optional
@@ -33,6 +34,7 @@ from ..infra.schemareg import SchemaRegistry
 from ..obs.tracer import Tracer
 from ..protocol import subjects as subj
 from ..protocol.types import (
+    BATCHABLE_OPS,
     BusPacket,
     ENV_EFFECTIVE_CONFIG,
     JobCancel,
@@ -40,7 +42,16 @@ from ..protocol.types import (
     JobRequest,
     JobResult,
     JobState,
+    LABEL_BATCH_KEY,
     LABEL_DRY_RUN,
+    LABEL_OP,
+    LABEL_SESSION_KEY,
+    LABEL_SLO_CLASS,
+    Priority,
+    SERVING_OPS,
+    SPAN_ERROR,
+    SPAN_OK,
+    Span,
     SystemAlert,
 )
 from ..utils.ids import new_id, now_us
@@ -69,6 +80,17 @@ def split_job_id(job_id: str) -> tuple[str, str, int]:
     return run_id, step_key, int(attempt)
 
 
+def run_session_key(run: WorkflowRun) -> str:
+    """The per-run serving session key: an explicit run label wins, else a
+    run-scoped default.  Every ``llm.generate`` step of the run carries it,
+    so turn N routes via session affinity to the worker already holding the
+    session's KV pages (docs/WORKFLOWS.md §Session continuity)."""
+    return run.labels.get(LABEL_SESSION_KEY) or f"wf:{run.run_id}"
+
+
+_PRIORITY_VALUES = frozenset(p.value for p in Priority)
+
+
 def child_key(step_id: str, index: int) -> str:
     return f"{step_id}#{index}"
 
@@ -91,6 +113,7 @@ class Engine:
         configsvc: Optional[ConfigService] = None,
         metrics: Optional[Metrics] = None,
         instance_id: str = "wf-engine-0",
+        context_svc: Any = None,
     ):
         self.store = store
         self.bus = bus
@@ -100,6 +123,11 @@ class Engine:
         self.metrics = metrics or Metrics()
         self.instance_id = instance_id
         self.tracer = Tracer("workflow-engine", bus)
+        # ContextService executing context.update / context.window steps
+        # in-engine; its embedder submits embed jobs to the worker pool, so
+        # the heavy leg still rides micro-batching (docs/WORKFLOWS.md)
+        self.context_svc = context_svc
+        self._context_tasks: set = set()
 
     # ------------------------------------------------------------------
     # run lifecycle
@@ -140,7 +168,19 @@ class Engine:
             created_at_us=now_us(),
             dry_run=dry_run,
             labels=labels or {},
+            # one trace per run: every step-dispatch span parents under the
+            # root span emitted at run end, so the whole agent loop renders
+            # as a single waterfall with per-step critical-path blame
+            trace_id=new_id(),
+            root_span_id=new_id(),
         )
+        # resolve the SLO class once and pin it as a run label (a caller
+        # label override wins over the workflow default); every dispatched
+        # JobRequest.priority reads it back
+        slo = (run.labels.get(LABEL_SLO_CLASS) or wf.slo_class or "").upper()
+        if slo in _PRIORITY_VALUES:
+            run.labels[LABEL_SLO_CLASS] = slo
+        self.metrics.workflow_runs.inc(status="STARTED")
         if idempotency_key:
             # persist the run shell BEFORE claiming the key: the loser of the
             # setnx race must always be able to read the winner's run
@@ -330,6 +370,13 @@ class Engine:
         sr.job_id = job_id
         scope = self._scope(run, item=item, index=index)
         payload = expand_templates(step.input, scope)
+        op = str(payload.get("op", "")) if isinstance(payload, dict) else ""
+        if op in SERVING_OPS and not payload.get("session_id"):
+            # agent-loop continuity: default the serving session to the
+            # per-run key, so turn N of the loop prefills once and every
+            # later turn routes (session affinity) to the worker already
+            # holding the pages — no cold prefill across turns
+            payload["session_id"] = run_session_key(run)
         if index is not None:
             payload = {"item": item, "foreach_index": index, "input": payload}
         if self.schemas is not None and step.input_schema_id:
@@ -339,20 +386,31 @@ class Engine:
                 sr.error = f"input schema validation failed: {errs}"
                 await self._timeline(run, key, "step_failed", sr.error)
                 return
-        req = await self._build_job_request(run, step, job_id, payload, index)
-        # each step dispatch opens a fresh trace rooted at this span; the
-        # scheduler/worker legs attach below it via the packet's span context
-        trace_id = new_id()
+        if op in M.CONTEXT_STEP_OPS:
+            # context.* steps execute in-engine against the ContextService;
+            # the embeds inside still ride the worker pool (BusEmbedder) as
+            # micro-batched jobs.  Completion feeds back through the normal
+            # result path so run locking applies unchanged.
+            await self.mem.put_context(job_id, payload)
+            self._spawn_context_step(run, step, job_id, payload, key)
+            self.metrics.workflow_steps.inc(topic=step.topic)
+            await self._timeline(run, key, "step_dispatched", job_id)
+            return
+        req = await self._build_job_request(run, step, job_id, payload, index, op=op)
+        # step-dispatch spans parent under the run's root span — the whole
+        # run is ONE trace; scheduler/worker legs attach below via the
+        # packet's span context
         async with self.tracer.span(
             "step-dispatch",
-            trace_id=trace_id,
+            trace_id=run.trace_id or new_id(),
+            parent_span_id=run.root_span_id,
             attrs={"run_id": run.run_id, "step": key, "job_id": job_id},
         ) as sp:
             await self.mem.put_context(job_id, payload)
             await self.bus.publish(
                 subj.SUBMIT,
                 BusPacket.wrap(
-                    req, trace_id=trace_id, sender_id=self.instance_id,
+                    req, trace_id=sp.trace_id, sender_id=self.instance_id,
                     span_id=sp.span_id,
                 ),
             )
@@ -360,14 +418,26 @@ class Engine:
         await self._timeline(run, key, "step_dispatched", job_id)
 
     async def _build_job_request(
-        self, run: WorkflowRun, step: Step, job_id: str, payload: Any, index: Optional[int]
+        self, run: WorkflowRun, step: Step, job_id: str, payload: Any,
+        index: Optional[int], op: str = "",
     ) -> JobRequest:
         """Reference buildJobRequest (engine.go:1320-1415): step meta →
-        JobMetadata, route labels, dry-run label, effective-config env."""
+        JobMetadata, route labels, dry-run label, effective-config env —
+        plus the gateway submit path's routing labels (op / session key) and
+        the run's SLO class as the job priority."""
         labels = dict(step.route_labels)
         labels.update(run.labels)
         if run.dry_run:
             labels[LABEL_DRY_RUN] = "true"
+        # mirror gateway _submit_one label stamping: consumers (throughput
+        # matrix, session/batch affinity) never read the payload behind the
+        # context pointer
+        if op and LABEL_OP not in labels:
+            labels[LABEL_OP] = op
+        if op in SERVING_OPS and LABEL_SESSION_KEY not in labels:
+            labels[LABEL_SESSION_KEY] = run_session_key(run)
+        if op in BATCHABLE_OPS and LABEL_BATCH_KEY not in labels:
+            labels[LABEL_BATCH_KEY] = op
         env: dict[str, str] = {}
         if index is not None:
             env["foreach_index"] = str(index)
@@ -384,9 +454,11 @@ class Engine:
                 requires=list(step.meta.get("requires") or []),
                 pack_id=str(step.meta.get("pack_id", "")),
             )
+        slo = labels.get(LABEL_SLO_CLASS, "")
         return JobRequest(
             job_id=job_id,
             topic=step.topic,
+            priority=slo if slo in _PRIORITY_VALUES else Priority.BATCH.value,
             context_ptr=f"kv://ctx:{job_id}",
             tenant_id=run.org_id,
             labels=labels,
@@ -395,6 +467,106 @@ class Engine:
             run_id=run.run_id,
             metadata=meta,
         )
+
+    # ------------------------------------------------------------------
+    # context.* steps (docs/WORKFLOWS.md §Context engine on the pool)
+    # ------------------------------------------------------------------
+    def _spawn_context_step(
+        self, run: WorkflowRun, step: Step, job_id: str, payload: dict, key: str
+    ) -> None:
+        """Run a context.* step as a background task.  The task publishes a
+        normal JobResult on ``sys.workflow.step.result`` when done, so the
+        queue-group consumer applies it under the run lock exactly like a
+        worker result (multi-replica safe) while the scheduler — which never
+        saw these jobs — stays out of the loop; embedded/unit setups without
+        a result consumer get the result applied directly."""
+        task = asyncio.ensure_future(
+            self._run_context_step(run, step, job_id, payload, key)
+        )
+        self._context_tasks.add(task)
+        task.add_done_callback(self._context_tasks.discard)
+
+    async def drain_context_steps(self) -> None:
+        """Await in-flight context.* executor tasks (tests / benches)."""
+        while self._context_tasks:
+            await asyncio.gather(*list(self._context_tasks), return_exceptions=True)
+
+    async def _run_context_step(
+        self, run: WorkflowRun, step: Step, job_id: str, payload: dict, key: str
+    ) -> None:
+        res = JobResult(job_id=job_id, worker_id=self.instance_id)
+        sp = self.tracer.begin(
+            "context-execute",
+            trace_id=run.trace_id,
+            parent_span_id=run.root_span_id,
+            attrs={"run_id": run.run_id, "step": key,
+                   "op": str(payload.get("op", ""))},
+        )
+        t0 = time.monotonic()
+        try:
+            if self.context_svc is None:
+                raise WorkflowError("no context service wired into this engine")
+            coro = self._execute_context_op(run, payload)
+            if step.timeout_sec > 0:
+                output = await asyncio.wait_for(coro, step.timeout_sec)
+            else:
+                output = await coro
+            res.result_ptr = await self.mem.put_result(job_id, output)
+            res.status = JobState.SUCCEEDED.value
+        except asyncio.TimeoutError:
+            res.status = JobState.TIMEOUT.value
+            res.error_code = "CONTEXT_TIMEOUT"
+            res.error_message = f"context step exceeded {step.timeout_sec}s"
+        except Exception as e:  # noqa: BLE001 - becomes a step failure
+            res.status = JobState.FAILED.value
+            res.error_code = "CONTEXT_STEP"
+            res.error_message = str(e)
+        res.execution_ms = int((time.monotonic() - t0) * 1000)
+        ok = res.status == JobState.SUCCEEDED.value
+        await self.tracer.finish(sp, status=SPAN_OK if ok else SPAN_ERROR)
+        if self.bus.has_listener(subj.STEP_RESULT):
+            await self.bus.publish(
+                subj.STEP_RESULT,
+                BusPacket.wrap(res, trace_id=run.trace_id,
+                               sender_id=self.instance_id, span_id=sp.span_id),
+            )
+        else:
+            await self.handle_job_result(res)
+
+    async def _execute_context_op(self, run: WorkflowRun, payload: dict) -> Any:
+        """``context.update`` appends chat events / (re-)indexes RAG chunks;
+        ``context.window`` builds the model window.  The memory defaults to
+        the run's session key so an agent loop reads the memory it wrote."""
+        svc = self.context_svc
+        op = str(payload.get("op", ""))
+        memory_id = str(payload.get("memory_id") or run_session_key(run))
+        if op == "context.update":
+            await svc.update_memory(
+                memory_id,
+                user_payload=payload.get("user_payload"),
+                model_response=str(payload.get("model_response", "")),
+                mode=str(payload.get("mode", "CHAT")),
+            )
+            embedded = 0
+            chunks = payload.get("chunks")
+            if chunks:
+                embedded = await svc.put_chunks(memory_id, list(chunks))
+            if payload.get("summary"):
+                await svc.set_summary(memory_id, str(payload["summary"]))
+            return {"memory_id": memory_id, "updated": True, "embedded": embedded}
+        if op == "context.window":
+            msgs = await svc.build_window(
+                memory_id,
+                mode=str(payload.get("mode", "CHAT")),
+                payload=payload.get("payload", payload.get("query")),
+                max_input_tokens=int(payload.get("max_input_tokens", 0) or 4000),
+            )
+            return {
+                "memory_id": memory_id,
+                "messages": [m.to_dict() for m in msgs],
+                "message_count": len(msgs),
+            }
+        raise WorkflowError(f"unknown context op {op!r}")
 
     @staticmethod
     def _delay_wake_us(step: Step) -> int:
@@ -474,6 +646,13 @@ class Engine:
         else:
             return True  # non-terminal hint
 
+        if sr.status in M.STEP_TERMINAL and sr.started_at_us:
+            # wall-clock step latency (dispatch → terminal result), with the
+            # run trace as exemplar so a slow bucket resolves to a waterfall
+            self.metrics.workflow_step_seconds.observe(
+                max(0.0, ((sr.finished_at_us or now_us()) - sr.started_at_us) / 1e6),
+                exemplar=run.trace_id, topic=step.topic,
+            )
         await self._after_result(run, wf, step, parent, sr)
         return True
 
@@ -559,8 +738,33 @@ class Engine:
     # rollup
     # ------------------------------------------------------------------
     async def _rollup_and_save(self, run: WorkflowRun, wf: Workflow) -> None:
+        was_terminal = run.status in M.RUN_TERMINAL
         self._update_run_status(run, wf)
+        if run.status in M.RUN_TERMINAL and not was_terminal:
+            await self._finish_run(run)
         await self.store.put_run(run)
+
+    async def _finish_run(self, run: WorkflowRun) -> None:
+        """The run just went terminal: count it and emit the run-root span
+        (explicit start = run creation), closing the one-trace-per-run
+        waterfall every step-dispatch/execute span parented under."""
+        self.metrics.workflow_runs.inc(status=run.status)
+        if run.trace_id and run.root_span_id:
+            await self.tracer.emit(
+                Span(
+                    span_id=run.root_span_id,
+                    trace_id=run.trace_id,
+                    name="workflow-run",
+                    service="workflow-engine",
+                    start_us=run.created_at_us,
+                    end_us=run.finished_at_us or now_us(),
+                    attrs={
+                        "run_id": run.run_id,
+                        "workflow_id": run.workflow_id,
+                        "status": run.status,
+                    },
+                )
+            )
 
     def _update_run_status(self, run: WorkflowRun, wf: Workflow) -> None:
         """Reference updateRunStatus (engine.go:1647-1699)."""
@@ -645,6 +849,7 @@ class Engine:
         run.status = M.CANCELLED
         run.error = reason
         run.finished_at_us = now_us()
+        await self._finish_run(run)
         await self._timeline(run, "", "run_cancelled", reason)
         await self.store.put_run(run)
         return run
@@ -671,6 +876,10 @@ class Engine:
             created_at_us=now_us(),
             dry_run=dry_run,
             labels=dict(src.labels),
+            # a rerun is a fresh trace: the re-executed closure renders as
+            # its own waterfall, linked back via the rerun_from timeline row
+            trace_id=new_id(),
+            root_span_id=new_id(),
         )
         for sid in wf.steps:
             if sid in closure:

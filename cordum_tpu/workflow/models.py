@@ -15,6 +15,16 @@ from ..utils.ids import now_us
 
 BUILTIN_STEP_TYPES = {"approval", "condition", "delay", "notify"}
 
+# Workflow SLO classes: mirror protocol.types.Priority values (kept as a
+# local literal so the model layer stays dependency-free).  The class rides
+# into every dispatched JobRequest.priority, so a whole agent swarm can be
+# shed on the admission ladder before one interactive loop degrades.
+SLO_CLASSES = ("INTERACTIVE", "BATCH", "CRITICAL")
+
+# ops the engine executes in-process against the ContextService (the embeds
+# themselves still run as pool jobs); every other op dispatches on the bus
+CONTEXT_STEP_OPS = ("context.update", "context.window")
+
 # run / step statuses
 PENDING = "PENDING"
 RUNNING = "RUNNING"
@@ -127,6 +137,9 @@ class Workflow:
     org_id: str = ""
     version: int = 1
     input_schema_id: str = ""
+    # SLO class stamped on every dispatched JobRequest.priority ("" = BATCH);
+    # a run label `cordum.slo_class` overrides it per run
+    slo_class: str = ""
     steps: dict[str, Step] = field(default_factory=dict)
     labels: dict[str, str] = field(default_factory=dict)
     created_at_us: int = 0
@@ -139,6 +152,7 @@ class Workflow:
             org_id=str(d.get("org_id", "")),
             version=int(d.get("version", 1)),
             input_schema_id=str(d.get("input_schema_id", "")),
+            slo_class=str(d.get("slo_class", "")).upper(),
             labels={str(k): str(v) for k, v in (d.get("labels") or {}).items()},
             created_at_us=int(d.get("created_at_us", 0) or now_us()),
         )
@@ -153,6 +167,7 @@ class Workflow:
             "org_id": self.org_id,
             "version": self.version,
             "input_schema_id": self.input_schema_id,
+            "slo_class": self.slo_class,
             "labels": self.labels,
             "created_at_us": self.created_at_us,
             "steps": {sid: s.to_dict() for sid, s in self.steps.items()},
@@ -160,6 +175,10 @@ class Workflow:
 
     def validate(self) -> list[str]:
         errs = []
+        if self.slo_class and self.slo_class not in SLO_CLASSES:
+            errs.append(
+                f"unknown slo_class {self.slo_class!r} (one of {', '.join(SLO_CLASSES)})"
+            )
         for sid, step in self.steps.items():
             for dep in step.depends_on:
                 if dep not in self.steps:
@@ -226,6 +245,10 @@ class WorkflowRun:
     error: str = ""
     dry_run: bool = False
     labels: dict[str, str] = field(default_factory=dict)
+    # run-level trace: every step-dispatch span parents under one root span
+    # so the whole agent loop renders as ONE waterfall with per-step blame
+    trace_id: str = ""
+    root_span_id: str = ""
 
     def to_dict(self) -> dict[str, Any]:
         d = dict(self.__dict__)

@@ -7,6 +7,7 @@ list ``wf:run:timeline:<id>``, idempotency ``wf:run:idempotency:<key>``.
 """
 from __future__ import annotations
 
+import asyncio
 import json
 from typing import Optional
 
@@ -15,6 +16,8 @@ from ..utils.ids import now_us
 from .models import RUN_TERMINAL, TimelineEvent, Workflow, WorkflowRun
 
 TIMELINE_CAP = 500
+
+RUN_LOCK_PREFIX = "lock:wfrun:"
 
 
 def def_key(wf_id: str) -> str:
@@ -55,20 +58,25 @@ class WorkflowStore:
 
     # -- runs --------------------------------------------------------------
     async def put_run(self, run: WorkflowRun) -> None:
+        # one pipelined commit instead of ~11 serial KV round trips: put_run
+        # sits on the result hot path (every applied step re-saves the run),
+        # so the blob + index maintenance ship as a single PIPE frame
         run.updated_at_us = now_us()
-        await self.kv.set(run_key(run.run_id), json.dumps(run.to_dict()).encode())
-        await self.kv.zadd("wf:run:index", run.run_id, float(run.created_at_us or run.updated_at_us))
-        await self.kv.zadd(f"wf:run:wf:{run.workflow_id}", run.run_id, float(run.created_at_us))
+        pipe = self.kv.pipeline()
+        pipe.set(run_key(run.run_id), json.dumps(run.to_dict()).encode())
+        pipe.zadd("wf:run:index", run.run_id, float(run.created_at_us or run.updated_at_us))
+        pipe.zadd(f"wf:run:wf:{run.workflow_id}", run.run_id, float(run.created_at_us))
         # status indexes: remove from all, add to current
         for st in ("PENDING", "RUNNING", "WAITING", "WAITING_APPROVAL", "SUCCEEDED", "FAILED", "CANCELLED"):
             if st != run.status:
-                await self.kv.zrem(f"wf:run:status:{st}", run.run_id)
-        await self.kv.zadd(f"wf:run:status:{run.status}", run.run_id, float(run.updated_at_us))
+                pipe.zrem(f"wf:run:status:{st}", run.run_id)
+        pipe.zadd(f"wf:run:status:{run.status}", run.run_id, float(run.updated_at_us))
         if run.org_id:
             if run.status in RUN_TERMINAL:
-                await self.kv.zrem(f"wf:run:org_active:{run.org_id}", run.run_id)
+                pipe.zrem(f"wf:run:org_active:{run.org_id}", run.run_id)
             else:
-                await self.kv.zadd(f"wf:run:org_active:{run.org_id}", run.run_id, float(run.updated_at_us))
+                pipe.zadd(f"wf:run:org_active:{run.org_id}", run.run_id, float(run.updated_at_us))
+        await pipe.execute()
 
     async def get_run(self, run_id: str) -> Optional[WorkflowRun]:
         b = await self.kv.get(run_key(run_id))
@@ -80,6 +88,25 @@ class WorkflowStore:
 
     async def list_run_ids_by_status(self, status: str, limit: int = 200) -> list[str]:
         return await self.kv.zrange(f"wf:run:status:{status}", 0, limit - 1)
+
+    async def list_run_ids_by_statuses(
+        self, statuses: tuple[str, ...], limit: int = 200
+    ) -> list[tuple[str, str]]:
+        """→ ``[(status, run_id), …]`` for several status indexes in ONE
+        concurrent batch of zrange reads (the reconciler's per-pass scan
+        used to pay one serial round trip per status)."""
+        rows = await asyncio.gather(
+            *(self.kv.zrange(f"wf:run:status:{st}", 0, limit - 1) for st in statuses)
+        )
+        return [(st, rid) for st, ids in zip(statuses, rows) for rid in ids]
+
+    async def get_runs(self, run_ids: list[str]) -> list[Optional[WorkflowRun]]:
+        """Batch run fetch (concurrent reads) for listings and reconciler
+        sweeps; order matches ``run_ids``, misses come back ``None``."""
+        blobs = await asyncio.gather(*(self.kv.get(run_key(r)) for r in run_ids))
+        return [
+            WorkflowRun.from_dict(json.loads(b)) if b else None for b in blobs
+        ]
 
     async def count_active_runs(self, org_id: str) -> int:
         return await self.kv.zcard(f"wf:run:org_active:{org_id}")
@@ -115,9 +142,15 @@ class WorkflowStore:
 
     # -- run locks ------------------------------------------------------------
     async def acquire_run_lock(self, run_id: str, owner: str, ttl_s: float = 30.0) -> bool:
-        return await self.kv.setnx(f"lock:wfrun:{run_id}", owner.encode(), ttl_s)
+        return await self.kv.setnx(RUN_LOCK_PREFIX + run_id, owner.encode(), ttl_s)
 
     async def release_run_lock(self, run_id: str, owner: str) -> None:
-        cur = await self.kv.get(f"lock:wfrun:{run_id}")
-        if cur is not None and cur.decode() == owner:
-            await self.kv.delete(f"lock:wfrun:{run_id}")
+        # owner-checked compare-and-delete in one round trip (del_eq) instead
+        # of the old read-then-delete pair
+        await self.kv.del_eq(RUN_LOCK_PREFIX + run_id, owner.encode())
+
+    async def held_run_locks(self) -> set[str]:
+        """Run ids whose lock key currently exists — ONE prefix scan, so the
+        reconciler can skip busy runs without a setnx round trip per run."""
+        keys = await self.kv.keys(RUN_LOCK_PREFIX)
+        return {k[len(RUN_LOCK_PREFIX):] for k in keys}

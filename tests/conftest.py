@@ -11,6 +11,9 @@ backend initializes."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# hermetic worker heartbeats: the suite saturates single-core CI hosts, and
+# real loadavg-derived cpu_load would flip every worker to overloaded
+os.environ["CORDUM_HOST_LOAD"] = "0"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -305,6 +305,14 @@ _HEALTHY_GANG = {
     "gang_partial_reservations": 0.0,
 }
 
+# the agent-loop storm: multi-turn DAG runs rode session affinity end-to-end
+# (hit rate 1.0, zero re-prefills) with context embeds batched on the pool
+_HEALTHY_AGENTS = {
+    "agents_workflow_steps_per_sec": 170.0, "agents_affinity_hit_rate": 1.0,
+    "agents_context_embeds_per_sec": 80.0,
+    "agents_reprefills": 0.0, "agents_step_p99_ms": 20.0,
+}
+
 
 def test_floor_checker_passes_healthy_doc():
     mod = _floor_mod()
@@ -318,7 +326,7 @@ def test_floor_checker_passes_healthy_doc():
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
-           **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG}
+           **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG, **_HEALTHY_AGENTS}
     floors = json.loads((REPO / "bench_floor.json").read_text())
     assert mod.check(doc, floors) == []
 
@@ -338,7 +346,7 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
-           **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG}
+           **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG, **_HEALTHY_AGENTS}
     violations = mod.check(doc, floors)
     assert violations and "value" in violations[0]
     # ceilings guard the other direction (round-trip budget regression)

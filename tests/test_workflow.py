@@ -514,3 +514,233 @@ async def test_run_status_indexes(kv):
     assert "r1" not in await store.list_run_ids_by_status(M.RUNNING)
     assert "r1" in await store.list_run_ids_by_status(M.SUCCEEDED)
     assert await store.count_active_runs("o") == 0
+
+
+# ------------------------------------------------- agentic serving (DAG ⇄ pool)
+
+async def test_slo_class_becomes_job_priority():
+    from cordum_tpu.protocol.types import LABEL_SLO_CLASS
+
+    h = Harness()
+    await h.setup(wf_doc({"a": {"topic": "job.t", "input": {"op": "echo"}}},
+                         slo_class="interactive"))
+    run = await h.engine.start_run("wf1", {})
+    # resolved once, pinned as a run label, read back on every dispatch
+    assert run.labels[LABEL_SLO_CLASS] == "INTERACTIVE"
+    assert h.dispatched[0].priority == "INTERACTIVE"
+
+
+async def test_slo_run_label_overrides_workflow_default():
+    from cordum_tpu.protocol.types import LABEL_SLO_CLASS
+
+    h = Harness()
+    await h.setup(wf_doc({"a": {"topic": "job.t"}}, slo_class="BATCH"))
+    run = await h.engine.start_run("wf1", {}, labels={LABEL_SLO_CLASS: "CRITICAL"})
+    assert run.labels[LABEL_SLO_CLASS] == "CRITICAL"
+    assert h.dispatched[0].priority == "CRITICAL"
+
+
+async def test_unknown_slo_class_rejected_and_defaulted():
+    # validate() rejects it at workflow-create time…
+    wf = Workflow.from_dict(wf_doc({"a": {"topic": "t"}}, slo_class="GOLD"))
+    assert any("slo_class" in e for e in wf.validate())
+    # …and a bogus value smuggled past validation degrades to BATCH priority
+    h = Harness()
+    wf2 = Workflow.from_dict(wf_doc({"a": {"topic": "job.t"}}, slo_class="GOLD"))
+    await h.store.put_workflow(wf2)
+
+    async def capture(subject, pkt):
+        if pkt.job_request:
+            h.dispatched.append(pkt.job_request)
+
+    await h.bus.subscribe(subj.SUBMIT, capture)
+    await h.engine.start_run("wf1", {})
+    assert h.dispatched[0].priority == "BATCH"
+
+
+async def test_serving_step_gets_session_stamped():
+    from cordum_tpu.protocol.types import LABEL_SESSION_KEY
+
+    h = Harness()
+    await h.setup(wf_doc({
+        "gen": {"topic": "job.tpu.generate",
+                "input": {"op": "llm.generate", "tokens": [1, 2], "max_new_tokens": 4}},
+        "other": {"topic": "job.t", "input": {"op": "echo"}},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    by_step = {r.job_id.split(":")[1].split("@")[0]: r for r in h.dispatched}
+    # payload: the serving op defaults session_id to the per-run key…
+    gen_payload = await h.mem.get_pointer(by_step["gen"].context_ptr)
+    assert gen_payload["session_id"] == f"wf:{run.run_id}"
+    # …and the routing label matches, so session affinity steers the job
+    assert by_step["gen"].labels[LABEL_SESSION_KEY] == f"wf:{run.run_id}"
+    # non-serving steps get neither
+    other_payload = await h.mem.get_pointer(by_step["other"].context_ptr)
+    assert "session_id" not in other_payload
+    assert LABEL_SESSION_KEY not in by_step["other"].labels
+
+
+async def test_session_key_label_carries_across_runs():
+    """Two runs started with the same cordum.session_key label land on ONE
+    serving session — the cross-turn agent-loop continuity contract."""
+    from cordum_tpu.protocol.types import LABEL_SESSION_KEY
+
+    h = Harness()
+    await h.setup(wf_doc({
+        "gen": {"topic": "job.tpu.generate", "input": {"op": "llm.generate"}}}))
+    for _ in range(2):
+        await h.engine.start_run("wf1", {}, labels={LABEL_SESSION_KEY: "sess-9"})
+    assert len(h.dispatched) == 2
+    for req in h.dispatched:
+        assert req.labels[LABEL_SESSION_KEY] == "sess-9"
+        payload = await h.mem.get_pointer(req.context_ptr)
+        assert payload["session_id"] == "sess-9"
+
+
+async def test_explicit_session_id_wins_over_run_key():
+    h = Harness()
+    await h.setup(wf_doc({
+        "gen": {"topic": "job.tpu.generate",
+                "input": {"op": "llm.generate", "session_id": "pinned"}}}))
+    await h.engine.start_run("wf1", {})
+    payload = await h.mem.get_pointer(h.dispatched[0].context_ptr)
+    assert payload["session_id"] == "pinned"
+
+
+class _InlineEmbedder:
+    """Sync embedder: deterministic unit-norm hash vectors (test-local)."""
+
+    dim = 8
+
+    def embed(self, texts):
+        import numpy as np
+
+        out = np.zeros((len(texts), self.dim), dtype=np.float32)
+        for i, t in enumerate(texts):
+            out[i, hash(t) % self.dim] = 1.0
+        return out
+
+
+def _context_harness():
+    from cordum_tpu.context.service import ContextService
+
+    h = Harness()
+    h.engine.context_svc = ContextService(h.kv, embedder=_InlineEmbedder())
+    return h
+
+
+async def _drain_until_terminal(h, run_id, rounds=20):
+    for _ in range(rounds):
+        await h.engine.drain_context_steps()
+        run = await h.store.get_run(run_id)
+        if run.status in M.RUN_TERMINAL:
+            return run
+        await asyncio.sleep(0.01)
+    return await h.store.get_run(run_id)
+
+
+async def test_context_steps_execute_in_engine():
+    """context.update / context.window run through the ContextService and
+    never reach the scheduler (no SUBMIT for them)."""
+    h = _context_harness()
+    await h.setup(wf_doc({
+        "up": {"topic": "job.tpu.context",
+               "input": {"op": "context.update", "user_payload": "hello",
+                         "model_response": "world",
+                         "chunks": [{"file_path": "notes", "content": "alpha beta"}]}},
+        "win": {"topic": "job.tpu.context", "depends_on": ["up"],
+                "input": {"op": "context.window", "mode": "RAG", "query": "alpha"}},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    run = await _drain_until_terminal(h, run.run_id)
+    assert run.status == M.SUCCEEDED, (run.status, run.error)
+    assert h.dispatched == []  # the scheduler never saw these jobs
+    up = run.context["steps"]["up"]
+    assert up["updated"] and up["embedded"] == 1
+    win = run.context["steps"]["win"]
+    assert win["message_count"] >= 1
+    # the memory defaults to the run session key → agent loop reads its own writes
+    assert up["memory_id"] == f"wf:{run.run_id}" == win["memory_id"]
+
+
+async def test_context_step_without_service_fails_step():
+    h = Harness()  # no context_svc wired
+    await h.setup(wf_doc({
+        "up": {"topic": "job.tpu.context", "input": {"op": "context.update"}}}))
+    run = await h.engine.start_run("wf1", {})
+    run = await _drain_until_terminal(h, run.run_id)
+    assert run.status == M.FAILED
+    assert "context service" in (run.steps["up"].error or "")
+
+
+async def test_run_is_one_trace_with_root_span():
+    h = Harness()
+    spans = []
+
+    async def tap(subject, pkt):
+        if pkt.span is not None:
+            spans.append(pkt.span)
+
+    await h.bus.subscribe(subj.TRACE_SPAN, tap)
+    await h.setup(wf_doc({
+        "a": {"topic": "job.t"},
+        "b": {"topic": "job.t", "depends_on": ["a"]},
+    }))
+    run = await h.engine.start_run("wf1", {})
+    assert run.trace_id and run.root_span_id
+    await h.succeed(h.dispatched[0].job_id, {})
+    await h.succeed(h.dispatched[1].job_id, {})
+    fin = await h.store.get_run(run.run_id)
+    assert fin.status == M.SUCCEEDED
+    # every span of the run shares ONE trace id
+    assert spans and {s.trace_id for s in spans} == {run.trace_id}
+    dispatch = [s for s in spans if s.name == "step-dispatch"]
+    assert len(dispatch) == 2
+    # …and parents under the run root span, which is emitted at run end
+    assert {s.parent_span_id for s in dispatch} == {run.root_span_id}
+    roots = [s for s in spans if s.name == "workflow-run"]
+    assert len(roots) == 1 and roots[0].span_id == run.root_span_id
+    # root span brackets the whole run (starts at created_at, not at finish)
+    assert roots[0].start_us <= dispatch[0].start_us
+
+
+async def test_workflow_metrics_families_increment():
+    h = Harness()
+    await h.setup(wf_doc({"a": {"topic": "job.t"}}))
+    run = await h.engine.start_run("wf1", {})
+    await h.succeed(h.dispatched[0].job_id, {})
+    text = h.engine.metrics.render()
+    assert 'cordum_workflow_runs_total{status="STARTED"}' in text
+    assert 'cordum_workflow_runs_total{status="SUCCEEDED"}' in text
+    assert 'cordum_workflow_steps_total{topic="job.t"}' in text
+    assert "cordum_workflow_step_seconds" in text
+
+
+def test_floor_checker_gates_agents_keys():
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo / "tools"))
+    try:
+        import check_bench_floor as mod
+    finally:
+        sys.path.pop(0)
+    floors = json.loads((repo / "bench_floor.json").read_text())
+    base = {"agents_workflow_steps_per_sec": 40.0, "agents_step_p99_ms": 20.0,
+            "agents_affinity_hit_rate": 1.0, "agents_reprefills": 0.0,
+            "agents_context_embeds_per_sec": 50.0}
+    # healthy values: no agents-key violations (other keys flag missing)
+    assert not any("agents" in v for v in mod.check(dict(base), floors))
+    for key, bad in [("agents_workflow_steps_per_sec", 1.0),
+                     ("agents_step_p99_ms", 5000.0),
+                     ("agents_affinity_hit_rate", 0.5),
+                     ("agents_reprefills", 3.0),
+                     ("agents_context_embeds_per_sec", 0.0)]:
+        doc = dict(base)
+        doc[key] = bad
+        assert any(key in v for v in mod.check(doc, floors)), key
+    # a missing agents key is itself a violation (the gate cannot be skipped)
+    doc = dict(base)
+    doc.pop("agents_reprefills")
+    assert any("agents_reprefills" in v for v in mod.check(doc, floors))

@@ -191,3 +191,220 @@ async def test_full_stack_reconciler_replays_missed_result():
     assert fin.status == M.SUCCEEDED
     assert fin.context["steps"]["s"] == {"late": True}
     await s.stop()
+
+
+async def test_run_lock_nak_backoff_grows_with_redeliveries():
+    """Contended run lock → RetryAfter whose delay grows exponentially with
+    the redelivery count (jittered ±25 %), capped at MAX_NAK_DELAY_S."""
+    from cordum_tpu.controlplane.workflowengine.service import RUN_LOCK_NAK_BASE_S
+    from cordum_tpu.infra.bus import MAX_NAK_DELAY_S, RetryAfter
+    from cordum_tpu.protocol.types import JobResult
+
+    s = Stack()
+    # another replica holds the run lock
+    assert await s.wf_store.acquire_run_lock("run-x", "other-replica")
+    res = JobResult(job_id="run-x:step@1", status="SUCCEEDED")
+    delays = []
+    for redeliveries in range(12):
+        with pytest.raises(RetryAfter) as ei:
+            await s.wf_service.handle_job_result(res, redeliveries=redeliveries)
+        delays.append(ei.value.delay_s)
+        base = min(MAX_NAK_DELAY_S, RUN_LOCK_NAK_BASE_S * (2 ** redeliveries))
+        assert base * 0.75 <= delays[-1] <= base * 1.25
+    assert delays[-1] <= MAX_NAK_DELAY_S * 1.25  # capped, jitter rides on top
+    # non-workflow job ids pass straight through (no lock, no raise)
+    await s.wf_service.handle_job_result(JobResult(job_id="plain-id", status="SUCCEEDED"))
+    await s.bus.close()
+
+
+async def test_reconcile_skips_runs_locked_by_other_replica():
+    from cordum_tpu.workflow.models import WorkflowRun
+
+    s = Stack()
+    for rid in ("r-held", "r-free"):
+        await s.wf_store.put_run(WorkflowRun(
+            run_id=rid, workflow_id="nope", org_id="o",
+            status=M.RUNNING, created_at_us=1))
+    assert await s.wf_store.acquire_run_lock("r-held", "other-replica")
+    touched = []
+    orig = s.wf_engine.resume_due
+
+    async def spy(run_id):
+        touched.append(run_id)
+        return await orig(run_id)
+
+    s.wf_engine.resume_due = spy
+    await s.wf_service.reconcile_once()
+    # the held run is skipped off the lock-prefix scan; the free one is visited
+    assert touched == ["r-free"]
+    # live-run gauge reflects the batched status scan
+    assert "cordum_workflow_active_runs 2.0" in s.wf_engine.metrics.render()
+    await s.bus.close()
+
+
+async def test_replay_equivalent_to_live_result_path():
+    """Satellite: the reconciler's JobStore replay must produce the same run
+    state as the live bus path — same step output, status, and a faithful
+    execution_ms carried from the job meta audit trail."""
+    s = Stack()
+    gate = asyncio.Event()
+    gate.set()  # live path runs unblocked
+    done = asyncio.Event()
+
+    async def handler(ctx):
+        await gate.wait()
+        done.set()
+        return {"answer": 42}
+
+    await s.start(handler)
+    wf = Workflow.from_dict(
+        {"id": "eq", "name": "eq", "steps": {"s": {"topic": "job.work"}}})
+    await s.wf_store.put_workflow(wf)
+
+    # live path
+    live = await s.wf_engine.start_run("eq", {})
+    live = await s.wait_run(live.run_id)
+    assert live.status == M.SUCCEEDED
+
+    # replayed path: park the worker, detach the service (simulated crash),
+    # then let the result land with nobody listening
+    gate.clear()
+    done.clear()
+    replay = await s.wf_engine.start_run("eq", {})
+    await asyncio.sleep(0.05)  # plain sleep: a drain would park on `gate`
+    for sub in s.wf_service._subs:
+        sub.unsubscribe()
+    s.wf_service._task.cancel()
+    gate.set()
+    await done.wait()
+    await settle(s.bus, rounds=10)
+    assert (await s.wf_store.get_run(replay.run_id)).status == M.RUNNING
+    job_id = f"{replay.run_id}:s@1"
+    meta = await s.job_store.get_meta(job_id)
+    assert meta.get("state") == "SUCCEEDED" and meta.get("execution_ms")
+    assert await s.wf_service.reconcile_once() >= 1
+    replay = await s.wf_store.get_run(replay.run_id)
+
+    # equivalence: identical step output, status, and worker attribution
+    assert replay.status == live.status == M.SUCCEEDED
+    assert replay.context["steps"]["s"] == live.context["steps"]["s"] == {"answer": 42}
+    assert replay.steps["s"].status == live.steps["s"].status
+    await s.stop()
+
+
+async def test_rerun_from_full_stack():
+    """rerun_from re-executes the failed closure through the real
+    scheduler+worker and reuses upstream outputs without re-dispatching."""
+    s = Stack()
+    flaky = {"ok": False}
+
+    async def handler(ctx):
+        p = ctx.payload or {}
+        if p.get("which") == "b" and not flaky["ok"]:
+            raise RuntimeError("b broken")
+        return {"which": p.get("which"), "ran": True}
+
+    await s.start(handler)
+    wf = Workflow.from_dict({
+        "id": "rr", "name": "rr",
+        "steps": {"a": {"topic": "job.work", "input": {"which": "a"}},
+                  "b": {"topic": "job.work", "depends_on": ["a"],
+                        "input": {"which": "b"}}},
+    })
+    await s.wf_store.put_workflow(wf)
+    run = await s.wf_engine.start_run("rr", {})
+    run = await s.wait_run(run.run_id)
+    assert run.status == M.FAILED
+
+    flaky["ok"] = True
+    rerun = await s.wf_engine.rerun_from(run.run_id, "b")
+    # a rerun is its own trace (fresh waterfall), linked via the timeline
+    assert rerun.trace_id and rerun.trace_id != run.trace_id
+    rerun = await s.wait_run(rerun.run_id)
+    assert rerun.status == M.SUCCEEDED, (rerun.status, rerun.error)
+    # upstream output carried over; only b re-dispatched in the rerun
+    assert rerun.context["steps"]["a"] == {"which": "a", "ran": True}
+    assert rerun.steps["a"].job_id == run.steps["a"].job_id  # not re-run
+    assert rerun.steps["b"].job_id.startswith(rerun.run_id)
+    tl = await s.wf_store.timeline(rerun.run_id)
+    assert any(e["event"] == "rerun_from" and e["detail"] == run.run_id for e in tl)
+    await s.stop()
+
+
+async def test_approval_rejection_fails_run_full_stack():
+    s = Stack()
+
+    async def handler(ctx):  # the deploy step must never run
+        raise AssertionError("dispatched past a rejected gate")
+
+    await s.start(handler)
+    wf = Workflow.from_dict({
+        "id": "rej", "name": "rej",
+        "steps": {"gate": {"type": "approval"},
+                  "deploy": {"topic": "job.work", "depends_on": ["gate"]}},
+    })
+    await s.wf_store.put_workflow(wf)
+    run = await s.wf_engine.start_run("rej", {})
+    assert run.status == M.WAITING_APPROVAL
+    run = await s.wf_engine.approve_step(
+        run.run_id, "gate", approve=False, approved_by="sec")
+    run = await s.wait_run(run.run_id)
+    assert run.status == M.FAILED
+    assert run.steps["deploy"].status in (M.PENDING, M.SKIPPED, M.CANCELLED)
+    await s.stop()
+
+
+async def test_cancel_mid_fanout_leaves_no_orphan_jobs():
+    """Cancelling a run while fan-out children are in flight must cancel
+    every dispatched job — nothing keeps running or pending in the
+    scheduler/worker after the run is CANCELLED."""
+    from cordum_tpu.protocol.types import TERMINAL_STATES
+
+    s = Stack()
+    gate = asyncio.Event()
+    started = asyncio.Event()
+
+    async def handler(ctx):
+        p = ctx.payload or {}
+        if isinstance(p, dict) and "item" in p:
+            started.set()
+            await gate.wait()  # children park here until released
+            return {"done": p["item"]}
+        return {"list": [1, 2, 3]}
+
+    await s.start(handler)
+    wf = Workflow.from_dict({
+        "id": "cx", "name": "cx",
+        "steps": {"seed": {"topic": "job.work"},
+                  "fan": {"topic": "job.work", "depends_on": ["seed"],
+                          "for_each": "steps.seed.list", "max_parallel": 2}},
+    })
+    await s.wf_store.put_workflow(wf)
+    run = await s.wf_engine.start_run("cx", {})
+    # plain sleeps: parked worker tasks would deadlock a drain
+    for _ in range(200):
+        if started.is_set():
+            break
+        await asyncio.sleep(0.01)
+    assert started.is_set(), "fan-out children never started"
+
+    run = await s.wf_engine.cancel_run(run.run_id, reason="operator abort")
+    assert run.status == M.CANCELLED
+    gate.set()
+    await settle(s.bus, rounds=10)
+
+    # every job the run ever dispatched is terminal in the job store
+    run = await s.wf_store.get_run(run.run_id)
+    job_ids = [t.job_id
+               for sr in run.steps.values()
+               for t in [sr, *sr.children.values()] if t.job_id]
+    assert job_ids, "expected dispatched jobs"
+    terminal = {st.value for st in TERMINAL_STATES}
+    for jid in job_ids:
+        meta = await s.job_store.get_meta(jid)
+        assert meta.get("state") in terminal, (jid, meta.get("state"))
+    # and no step (parent or child) is left non-terminal
+    for sr in run.steps.values():
+        for t in [sr, *sr.children.values()]:
+            assert t.status in M.STEP_TERMINAL, (t.step_id, t.status)
+    await s.stop()

@@ -644,7 +644,8 @@ class SubjectGraphConformance(ProgramRule):
         result = c.get("RESULT", "sys.job.result")
         cancel = c.get("CANCEL", "sys.job.cancel")
         if pattern in (submit, result, c.get("DLQ", "sys.job.dlq"),
-                       c.get("TRACE_SPAN", "sys.trace.span")):
+                       c.get("TRACE_SPAN", "sys.trace.span"),
+                       c.get("STEP_RESULT", "sys.workflow.step.result")):
             return True
         for parent in (submit, result, cancel):
             if pattern.startswith(parent + "."):

@@ -34,6 +34,13 @@ TPU worker as separate OS processes, then over plain HTTP:
      CANCELLED/FAILED), at least one finishes on the peer (live migration
      or requeue failover), the drained worker beacons draining and exits,
      and the fleet keeps serving afterwards
+ 12. agentic workflow serving: a 3-turn agent loop over one session —
+     each turn a generate → context.update/context.window → generate DAG
+     with `cordum.session_key` on the run — keeps every llm.generate of
+     the session on ONE worker (scheduler affinity hits observed in the
+     fleet exposition), runs its context embeds as real pool jobs, rides
+     the INTERACTIVE SLO class, and renders each run as one ≥3-stage
+     trace under the run root span; `cordumctl runs` renders the table
 
 Exit 0 = PASS.  Usage: python tools/platform_smoke.py [--keep]
 """
@@ -78,6 +85,9 @@ def spawn_stack(logdir: str) -> list[subprocess.Popen]:
         "PYTHONPATH": REPO + os.pathsep + base_env.get("PYTHONPATH", ""),
         "CORDUM_FORCE_CPU": "1",
         "JAX_PLATFORMS": "cpu",
+        # hermetic placement: don't let the harness's own CPU burn flip
+        # workers to overloaded (the smoke asserts exact worker identities)
+        "CORDUM_HOST_LOAD": "0",
     })
     sched_env = {
         "SAFETY_KERNEL_ADDR": f"http://127.0.0.1:{KERNEL_PORT}",
@@ -635,6 +645,121 @@ def main() -> int:
                     "post-drain traffic serves on the peer")
             else:
                 log("11. drain/failover: skipped (external deployment)")
+
+            # 12. agentic workflow serving (docs/WORKFLOWS.md): a 3-turn
+            # agent loop on ONE session.  Every run carries the same
+            # cordum.session_key, so the engine stamps session_id into each
+            # llm.generate payload and the scheduler's affinity cache keeps
+            # the whole session on one worker; context.update/window run
+            # in-engine with their embeds riding the pool as real embed
+            # jobs; the workflow's INTERACTIVE slo_class lands on the run
+            # labels; each run renders as one trace under the run root span.
+            def _affinity_hits(text: str) -> float:
+                return sum(
+                    float(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+                    if ln.startswith("cordum_session_affinity_total{")
+                    and 'outcome="hit"' in ln)
+
+            hits_before = _affinity_hits(
+                httpx.get(f"{API}/metrics?scope=fleet", timeout=10.0).text)
+            wf = {"id": "smoke-agent", "name": "agent loop",
+                  "slo_class": "INTERACTIVE",
+                  "steps": {
+                      "plan": {"topic": "job.tpu.generate",
+                               "input": {"op": "llm.generate",
+                                         "tokens": [2, 7, 1],
+                                         "max_new_tokens": 6}},
+                      "remember": {"topic": "job.tpu.context",
+                                   "depends_on": ["plan"],
+                                   "input": {"op": "context.update",
+                                             "user_payload": "${input.goal}",
+                                             "model_response":
+                                                 "plan ${steps.plan.tokens}",
+                                             "chunks": [{
+                                                 "file_path": "notes",
+                                                 "content": "agent planned "
+                                                            "${steps.plan.tokens}"}]}},
+                      "window": {"topic": "job.tpu.context",
+                                 "depends_on": ["remember"],
+                                 "input": {"op": "context.window",
+                                           "mode": "RAG",
+                                           "query": "${input.goal}"}},
+                      "act": {"topic": "job.tpu.generate",
+                              "depends_on": ["window"],
+                              "input": {"op": "llm.generate",
+                                        "tokens": [4, 4, 8],
+                                        "max_new_tokens": 6}},
+                  }}
+            r = c.post("/api/v1/workflows", json=wf)
+            assert r.status_code == 201, r.text
+            turn_workers = []
+            last_run = {}
+            for turn in range(3):
+                r = c.post("/api/v1/workflows/smoke-agent/runs",
+                           json={"input": {"goal": f"agent smoke turn {turn}"},
+                                 "labels": {"cordum.session_key": "agent-smoke"}})
+                assert r.status_code == 202, r.text
+                run_id = r.json()["run_id"]
+                last_run = wait_run(c, run_id, "SUCCEEDED")
+                steps_ctx = last_run["context"]["steps"]
+                # the RAG window saw the memory this (and earlier) turns wrote
+                assert steps_ctx["window"]["message_count"] >= 1, steps_ctx["window"]
+                assert len(steps_ctx["act"]["tokens"]) == 6, steps_ctx["act"]
+                workers = {}
+                for sid in ("plan", "act"):
+                    jd = c.get(f"/api/v1/jobs/{run_id}:{sid}@1").json()
+                    assert jd.get("state") == "SUCCEEDED", jd
+                    workers[sid] = jd.get("worker_id", "")
+                turn_workers.append(workers)
+            assert last_run.get("labels", {}).get("cordum.slo_class") == "INTERACTIVE", \
+                last_run.get("labels")
+            if not external:
+                # every llm.generate of the session stayed on the one live
+                # worker — the no-re-prefill contract
+                owners = {w for tw in turn_workers for w in tw.values()}
+                assert owners == {"smoke-w2"}, f"session hopped workers: {turn_workers}"
+                # and the affinity cache produced real hits (6 session jobs
+                # over <=2 shards: some shard routed a repeat)
+                hits_after = hits_before
+                t0 = time.time()
+                while time.time() - t0 < 30 and hits_after <= hits_before:
+                    hits_after = _affinity_hits(
+                        httpx.get(f"{API}/metrics?scope=fleet", timeout=10.0).text)
+                    if hits_after <= hits_before:
+                        time.sleep(1.0)
+                assert hits_after > hits_before, (hits_before, hits_after)
+            # one trace per run: the run root span plus >=3 distinct DAG
+            # stages parented under it
+            trace_id = last_run.get("trace_id", "")
+            assert trace_id, last_run
+            trace, stages, names = {}, set(), set()
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                trace = c.get(f"/api/v1/traces/{trace_id}").json()
+                spans = trace.get("spans") or []
+                stages = {(sp.get("attrs") or {}).get("step")
+                          for sp in spans} - {None}
+                names = {sp.get("name") for sp in spans}
+                if len(stages) >= 3 and "workflow-run" in names:
+                    break
+                time.sleep(0.5)
+            assert len(stages) >= 3, (stages, trace.get("span_count"))
+            assert "workflow-run" in names, names
+            runs_out = subprocess.run(
+                [sys.executable, "-m", "cordum_tpu.cli", "runs",
+                 "--workflow-id", "smoke-agent"],
+                capture_output=True, text=True, timeout=30, cwd=REPO,
+                env={**os.environ, "CORDUM_API_URL": API,
+                     "CORDUM_API_KEY": H_USER["X-Api-Key"],
+                     "PYTHONPATH": REPO + os.pathsep
+                     + os.environ.get("PYTHONPATH", "")},
+            )
+            assert runs_out.returncode == 0, runs_out.stderr
+            assert "smoke-agent" in runs_out.stdout, runs_out.stdout
+            assert "INTERACTIVE" in runs_out.stdout, runs_out.stdout
+            log(f"12. agent loop: 3 turns on one session, workers={turn_workers[-1]}, "
+                f"window={last_run['context']['steps']['window']['message_count']} msgs, "
+                f"trace stages={sorted(stages)}; cordumctl runs renders")
 
         log("PASS")
         return 0
