@@ -1361,6 +1361,12 @@ def _jax_child(device: str) -> None:
     except Exception as ex:  # noqa: BLE001
         out["disagg_error"] = f"{type(ex).__name__}: {ex}"[:300]
 
+    # --- multi-turn chat: prefix-cache TTFT + session tiering (ISSUE 18) ---
+    try:
+        out.update(asyncio.run(_bench_chat(device)))
+    except Exception as ex:  # noqa: BLE001
+        out["chat_error"] = f"{type(ex).__name__}: {ex}"[:300]
+
     print(json.dumps(out), flush=True)
 
 
@@ -1676,6 +1682,133 @@ async def _bench_session_migration() -> dict:
     return {
         "migration_pause_p50_ms": round(p50_s * 1000.0, 2),
         "migrations_done": migrations,
+    }
+
+
+async def _bench_chat(device: str) -> dict:
+    """Prefix-cache + session-tiering chat serving (ISSUE 18), three legs
+    on the real paged backend:
+
+      * **prefix TTFT**: N chat sessions sharing a 48-token system prompt,
+        run cold (``prefix_cache=False``) then against a primed cache in a
+        fresh engine — the hit pass prefills only the post-divergence
+        tokens, so its TTFT p50 must beat the cold pass (the
+        ``chat_prefix_ttft_speedup`` floor) while staying token-identical
+        (sharing is a placement change, not a math change).
+      * **residency**: M conversations with page-sized unique histories on
+        a small device arena, hibernated to the host-RAM cold arena by the
+        idle sweep between waves — the resident-conversation count must
+        exceed what the device arena could hold warm
+        (``chat_resident_over_capacity`` floor).
+      * **restore**: second turns for a sample of hibernated conversations
+        re-warm their cold pages; ``chat_restore_pause_p50_ms`` is the p50
+        alloc+scatter pause (ceiling in bench_floor.json)."""
+    from cordum_tpu.infra.metrics import Metrics
+    from cordum_tpu.models import llama
+    from cordum_tpu.serving.backend import LlamaServingBackend
+    from cordum_tpu.serving.engine import GenRequest, ServingEngine
+
+    async def run_blocking(fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    if device == "cpu":
+        lcfg = llama.LlamaConfig.tiny()
+        n_chat, n_resident, n_restore = 6, 24, 4
+    else:
+        lcfg = llama.LlamaConfig(vocab_size=32000, d_model=1024, n_layers=8,
+                                 n_heads=8, n_kv_heads=4, d_ff=3584,
+                                 max_seq_len=512)
+        n_chat, n_resident, n_restore = 16, 48, 8
+    page_size, max_new = 16, 8
+    vocab = lcfg.vocab_size
+    metrics = Metrics()
+
+    def make_engine(num_pages: int, prefix: bool,
+                    hibernate: float = 0.0) -> ServingEngine:
+        be = LlamaServingBackend(lcfg, num_pages=num_pages,
+                                 page_size=page_size)
+        be.prefill([1, 2, 3], [1])  # warm: TTFT never includes the compile
+        return ServingEngine(be, run_blocking=run_blocking,
+                             max_new_tokens_cap=max_new, prefix_cache=prefix,
+                             hibernate_after_s=hibernate, metrics=metrics)
+
+    # --- leg 1: prefix-hit TTFT vs cold, token-identical ---
+    system = [((i * 31) % (vocab - 2)) + 1 for i in range(48)]  # 3 full pages
+    prompts = [system + [((i * 7 + j) % 97) + 5 for j in range(4)]
+               for i in range(n_chat)]
+    arena = 8 + n_chat * (-(-(len(prompts[0]) + max_new) // page_size))
+
+    async def run_turns(eng, tag, plist, keyed=True):
+        outs = []
+        for i, p in enumerate(plist):
+            r = await asyncio.wait_for(eng.submit(
+                GenRequest(prompt=p, max_new_tokens=max_new, stream=False,
+                           session_key=f"{tag}-{i}" if keyed else ""),
+                job_id=f"{tag}{i}"), timeout=JAX_TIMEOUT_S / 4)
+            outs.append(r["tokens"])
+        return outs
+
+    cold_eng = make_engine(arena, prefix=False)
+    cold_outs = await run_turns(cold_eng, "cold", prompts)
+    cold_ttfts = sorted(cold_eng.stats.ttft_seconds)
+    await cold_eng.stop()
+
+    hit_eng = make_engine(arena, prefix=True)
+    await run_turns(hit_eng, "prime", [system])  # populate the radix cache
+    hit_outs = await run_turns(hit_eng, "hit", prompts)
+    hit_ttfts = sorted(list(hit_eng.stats.ttft_seconds)[1:])  # drop the prime
+    st = hit_eng.stats
+    looked = st.prefix_hits + st.prefix_misses
+    hit_rate = st.prefix_hits / looked if looked else 0.0
+    identical = int(hit_outs == cold_outs)
+    await hit_eng.stop()
+
+    # --- legs 2+3: residency above the device arena + restore pause ---
+    # per-conversation history = 2 unique full pages; a 32-page arena holds
+    # at most capacity//2 conversations warm, so residency beyond that is
+    # hibernation working, not slack
+    eng = make_engine(32, prefix=True, hibernate=3600.0)
+    capacity_sessions = (32 - 1) // 2
+    convo: dict[int, list[int]] = {}
+    for i in range(n_resident):
+        p = [((i * 131 + j * 17) % (vocab - 2)) + 1 for j in range(36)]
+        r = await asyncio.wait_for(eng.submit(
+            GenRequest(prompt=p, max_new_tokens=max_new, stream=False,
+                       session_key=f"conv-{i}"),
+            job_id=f"res{i}"), timeout=JAX_TIMEOUT_S / 4)
+        convo[i] = p + r["tokens"]
+        if (i + 1) % 6 == 0:  # idle sweep: demote everything to cold
+            await eng.tiering.sweep(now=time.monotonic() + 7200.0)
+    await eng.tiering.sweep(now=time.monotonic() + 7200.0)
+    warm, cold = eng.tiering.tier_counts()
+    resident = warm + cold
+    for i in range(n_restore):  # turn 2: cold pages re-warm on admission
+        p2 = convo[i] + [7]
+        await asyncio.wait_for(eng.submit(
+            GenRequest(prompt=p2, max_new_tokens=4, stream=False,
+                       session_key=f"conv-{i}"),
+            job_id=f"res2-{i}"), timeout=JAX_TIMEOUT_S / 4)
+    pf = eng.prefix.stats
+    restore_p50_s = metrics.serving_hibernate_pause.quantile(0.5) or 0.0
+    await eng.stop()
+
+    def p50_ms(vals) -> float:
+        return vals[len(vals) // 2] * 1000.0 if vals else 0.0
+
+    cold_p50, hit_p50 = p50_ms(cold_ttfts), p50_ms(hit_ttfts)
+    return {
+        "chat_ttft_cold_p50_ms": round(cold_p50, 2),
+        "chat_ttft_hit_p50_ms": round(hit_p50, 2),
+        "chat_prefix_ttft_speedup": round(cold_p50 / hit_p50, 2) if hit_p50 else 0.0,
+        "chat_prefix_hit_rate": round(hit_rate, 3),
+        "chat_token_identical": identical,
+        "chat_sessions": n_chat,
+        "chat_resident_sessions": resident,
+        "chat_device_session_capacity": capacity_sessions,
+        "chat_resident_over_capacity": round(resident / capacity_sessions, 2),
+        "chat_hibernated_pages": pf.hibernated_pages,
+        "chat_restored_pages": pf.restored_pages,
+        "chat_restore_pause_p50_ms": round(restore_p50_s * 1000.0, 2),
     }
 
 
@@ -2489,6 +2622,12 @@ _CHILD_METRIC_KEYS = (
     "disagg_inter_token_gain", "disagg_long_job_p50_ms",
     "colocated_long_job_p50_ms", "disagg_migrations_done",
     "disagg_decode_tokens_per_sec", "colocated_decode_tokens_per_sec",
+    "chat_ttft_cold_p50_ms", "chat_ttft_hit_p50_ms",
+    "chat_prefix_ttft_speedup", "chat_prefix_hit_rate",
+    "chat_token_identical", "chat_sessions", "chat_resident_sessions",
+    "chat_device_session_capacity", "chat_resident_over_capacity",
+    "chat_hibernated_pages", "chat_restored_pages",
+    "chat_restore_pause_p50_ms",
 )
 
 
@@ -2552,7 +2691,8 @@ def bench_jax(*, smoke: bool = False) -> dict:
                     results[k] = child[k]
                     results["fallback_device"] = child.get("device", "cpu")
             for k in ("embed_error", "model_error", "batched_error",
-                      "serving_error", "disagg_error", "child_traceback"):
+                      "serving_error", "disagg_error", "chat_error",
+                      "child_traceback"):
                 if k not in results and k in child:
                     results[k] = child[k]
             if "device" not in results and "device" in child:
@@ -2563,7 +2703,8 @@ def bench_jax(*, smoke: bool = False) -> dict:
                         ("model_tokens_per_sec", "model_error"),
                         ("batched_embeds_per_sec", "batched_error"),
                         ("decode_tokens_per_sec", "serving_error"),
-                        ("disagg_ttft_p50_ms", "disagg_error")):
+                        ("disagg_ttft_p50_ms", "disagg_error"),
+                        ("chat_prefix_ttft_speedup", "chat_error")):
         if metric in results and err in results and results.get("fallback_device"):
             results[f"tpu_{err}"] = results.pop(err)
     return results
@@ -2639,6 +2780,17 @@ def main() -> None:
         out.update(bench_session_affinity())
         out["value"] = out["decode_tokens_per_sec"]
         out["unit"] = "tokens/s"
+        print(json.dumps(out))
+        return
+    if "--chat" in sys.argv:
+        # chat mode (ISSUE 18): prefix-cache TTFT speedup + session-tiering
+        # residency/restore on the real paged backend.  One JSON line, same
+        # chat_* keys as the full bench so bench_floor.json gates both
+        # surfaces.
+        out = {"metric": "chat_prefix_ttft_speedup", "unit": "x"}
+        out.update(asyncio.run(_bench_chat(
+            "cpu" if os.environ.get("JAX_PLATFORMS", "") == "cpu" else "tpu")))
+        out["value"] = out.get("chat_prefix_ttft_speedup", 0.0)
         print(json.dumps(out))
         return
     if "--disagg" in sys.argv:
@@ -2786,6 +2938,26 @@ def main() -> None:
         "colocated_decode_tokens_per_sec": jx.get(
             "colocated_decode_tokens_per_sec", 0.0),
         "disagg_error": jx.get("disagg_error", ""),
+        # prefix cache + session tiering (ISSUE 18): multi-turn chat over a
+        # shared system prompt — prefix-hit TTFT vs cold (same-run ratio,
+        # token-identical), resident conversations held above the device
+        # arena via hibernation, and the cold→warm restore pause (speedup/
+        # residency floors + restore-pause ceiling in bench_floor.json)
+        "chat_ttft_cold_p50_ms": jx.get("chat_ttft_cold_p50_ms", 0.0),
+        "chat_ttft_hit_p50_ms": jx.get("chat_ttft_hit_p50_ms", 0.0),
+        "chat_prefix_ttft_speedup": jx.get("chat_prefix_ttft_speedup", 0.0),
+        "chat_prefix_hit_rate": jx.get("chat_prefix_hit_rate", 0.0),
+        "chat_token_identical": jx.get("chat_token_identical", 0),
+        "chat_sessions": jx.get("chat_sessions", 0),
+        "chat_resident_sessions": jx.get("chat_resident_sessions", 0),
+        "chat_device_session_capacity": jx.get(
+            "chat_device_session_capacity", 0),
+        "chat_resident_over_capacity": jx.get(
+            "chat_resident_over_capacity", 0.0),
+        "chat_hibernated_pages": jx.get("chat_hibernated_pages", 0),
+        "chat_restored_pages": jx.get("chat_restored_pages", 0),
+        "chat_restore_pause_p50_ms": jx.get("chat_restore_pause_p50_ms", 0.0),
+        "chat_error": jx.get("chat_error", ""),
         **affinity,
         # overload resilience (ISSUE 13): the multi-tenant storm at ~2×
         # measured capacity — interactive p99 holds, interactive shed ≈ 0,
@@ -2811,12 +2983,13 @@ def main() -> None:
         out["profile"] = prof
     for k in ("fallback_device", "tpu_skipped", "tpu_embed_error",
               "tpu_model_error", "tpu_batched_error", "tpu_serving_error",
-              "tpu_disagg_error"):
+              "tpu_disagg_error", "tpu_chat_error"):
         if k in jx:
             out[k] = jx[k]
     degraded = bool(out["embed_error"] or out["model_error"]
                     or out["batched_error"] or out["serving_error"]
-                    or out["disagg_error"] or out.get("gang_error"))
+                    or out["disagg_error"] or out["chat_error"]
+                    or out.get("gang_error"))
     out["degraded"] = degraded
     if degraded:
         out["child_traceback"] = jx.get("child_traceback", "")

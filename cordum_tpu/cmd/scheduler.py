@@ -208,7 +208,14 @@ async def main() -> None:
     async def _on_session_moved(subject: str, pkt) -> None:
         mv = pkt.session_moved
         if mv is not None and mv.session_key:
-            strategy.retarget_session(mv.session_key, mv.to_worker)
+            # reason="hibernated": the session's KV went to the worker's
+            # host-RAM cold arena — pin its affinity past the normal TTL so
+            # the next turn routes back to the only copy; "restored" (and
+            # every migration reason) retargets normally, which unpins
+            strategy.retarget_session(
+                mv.session_key, mv.to_worker,
+                pinned=(mv.reason == "hibernated"),
+            )
 
     moved_sub = await bus.subscribe(subj.SERVING_MOVED, _on_session_moved)
     await engine.start()

@@ -22,6 +22,12 @@ Disaggregation (docs/SERVING.md §Disaggregation): WORKER_SERVING_ROLE
 placement role — a "prefill" worker live-migrates each session to the
 best decode peer once its prompt finishes prefilling, or earlier once
 prefill crosses WORKER_SERVING_HANDOFF_TOKENS (``serving_handoff_tokens``).
+Prefix cache + tiering (docs/SERVING.md §Prefix cache and tiering):
+WORKER_SERVING_PREFIX_CACHE=0 (``serving_prefix_cache``) disables
+copy-on-write shared-prefix KV pages; WORKER_SERVING_HIBERNATE_AFTER
+(``serving_hibernate_after_s``, seconds) > 0 tiers cached prefixes idle
+past the threshold into the host-RAM cold arena and pins the session's
+scheduler affinity until the next turn restores them.
 
 Graceful drain (docs/SERVING.md §Migration, drain, and failover): SIGTERM
 (unless WORKER_DRAIN_ON_TERM=0) and ``cordumctl drain <worker>`` both put
@@ -130,6 +136,15 @@ async def main() -> None:
         or (pool.serving_prefill_budget if pool else 0) or 16,
         serving_handoff_tokens=_boot.env_int("WORKER_SERVING_HANDOFF_TOKENS", 0)
         or (pool.serving_handoff_tokens if pool else 0),
+        # prefix cache + tiering (docs/SERVING.md §Prefix cache and tiering)
+        serving_prefix_cache=(
+            env["WORKER_SERVING_PREFIX_CACHE"] != "0"
+            if "WORKER_SERVING_PREFIX_CACHE" in env
+            else (pool.serving_prefix_cache if pool else True)
+        ),
+        serving_hibernate_after_s=_boot.env_float(
+            "WORKER_SERVING_HIBERNATE_AFTER", 0.0)
+        or (pool.serving_hibernate_after_s if pool else 0.0),
         # gang scheduling (docs/GANG.md): member jobs rendezvous + run the
         # SPMD/MPMD step program; WORKER_GANG=0 opts the worker out
         gang=env.get("WORKER_GANG", "1") != "0",

@@ -59,6 +59,13 @@ BATCH_AFFINITY_TTL_S = 5.0
 # conversation's turns, after which its KV pages are presumed reclaimed and
 # re-routing is free.
 SESSION_AFFINITY_TTL_S = 120.0
+# Hibernated sessions keep affinity alive far past the normal TTL: their KV
+# lives in the owning worker's host-RAM cold arena (docs/SERVING.md §Prefix
+# cache and tiering), so the "pages presumed reclaimed" assumption behind the
+# 120s TTL does not apply — routing the next turn anywhere else silently
+# degrades to a cold re-prefill.  SessionMoved(reason="hibernated") pins the
+# entry; reason="restored" (or any normal retarget) unpins it.
+SESSION_HIBERNATE_TTL_S = 3600.0
 _AFFINITY_CAP = 1024
 # internal key namespace so an arbitrary session id can never collide with
 # a batch key (batch keys stay raw for back-compat)
@@ -205,6 +212,9 @@ class LeastLoadedStrategy(Strategy):
         self.metrics = metrics
         # affinity: batch_key / namespaced session_key -> (worker_id, stamp)
         self._affinity: dict[str, tuple[str, float]] = {}
+        # namespaced session keys whose entry uses SESSION_HIBERNATE_TTL_S
+        # (the conversation's KV is tiered to that worker's cold arena)
+        self._pinned: set[str] = set()
         # session-affinity outcome counters (the bench's affinity-hit-rate
         # source; mirrored to cordum_session_affinity_total when metrics set)
         self.session_affinity_hits = 0
@@ -251,7 +261,10 @@ class LeastLoadedStrategy(Strategy):
             # amortized prune: drop the oldest half (insertion-ordered dict)
             for k in list(itertools.islice(self._affinity, _AFFINITY_CAP // 2)):
                 del self._affinity[k]
+                self._pinned.discard(k)
         self._affinity[key] = (worker_id, time.monotonic())
+        # recording is (re-)election: only the hibernate retarget re-pins
+        self._pinned.discard(key)
 
     def evict_worker(self, worker_id: str) -> int:
         """Invalidate every affinity entry (session AND batch) pointing at
@@ -263,6 +276,7 @@ class LeastLoadedStrategy(Strategy):
         dead = [k for k, (wid, _) in self._affinity.items() if wid == worker_id]
         for k in dead:
             del self._affinity[k]
+            self._pinned.discard(k)  # dead worker's cold arena died with it
             if k.startswith(_SESSION_PREFIX):
                 self._count_session_affinity("evicted")
         return len(dead)
@@ -280,8 +294,11 @@ class LeastLoadedStrategy(Strategy):
         if ent is None:
             return ""
         worker_id, stamped = ent
+        if key in self._pinned:
+            ttl_s = SESSION_HIBERNATE_TTL_S  # cold-arena keepalive
         if time.monotonic() - stamped >= ttl_s:
             self._affinity.pop(key, None)
+            self._pinned.discard(key)
             return ""
         hb = self.registry.get(worker_id)
         if hb is None or hb.draining:
@@ -289,6 +306,7 @@ class LeastLoadedStrategy(Strategy):
             # (lazy mirror of evict_worker) instead of leaving it to block
             # the key until the TTL expires
             self._affinity.pop(key, None)
+            self._pinned.discard(key)
             if key.startswith(_SESSION_PREFIX):
                 self._count_session_affinity("evicted")
             return ""
@@ -394,15 +412,22 @@ class LeastLoadedStrategy(Strategy):
         if self.metrics is not None:
             self.metrics.session_affinity.inc(outcome=outcome)
 
-    def retarget_session(self, session_key: str, worker_id: str) -> None:
+    def retarget_session(
+        self, session_key: str, worker_id: str, *, pinned: bool = False
+    ) -> None:
         """Point a session's affinity at its new owner — a ``SessionMoved``
         announcement after a hand-off/rebalance/drain migration commits
         (docs/SERVING.md §Disaggregation).  Follow-up turns and cancels
         then route to the worker actually holding the KV pages instead of
-        the original placement."""
+        the original placement.  ``pinned`` (reason="hibernated") switches
+        the entry to :data:`SESSION_HIBERNATE_TTL_S`; any later normal
+        retarget — including reason="restored" — unpins it."""
         if not session_key or not worker_id:
             return
-        self._record_affinity(_SESSION_PREFIX + session_key, worker_id)
+        key = _SESSION_PREFIX + session_key
+        self._record_affinity(key, worker_id)
+        if pinned:
+            self._pinned.add(key)
         self._count_session_affinity("retargeted")
 
     def pick_subject(self, req: JobRequest) -> str:

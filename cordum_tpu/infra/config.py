@@ -91,6 +91,13 @@ class Pool:
     # prompts start moving before ingestion finishes); 0 = on completion.
     serving_role: str = ""  # prefill | decode | mixed ("" = mixed)
     serving_handoff_tokens: int = 0
+    # prefix cache + session tiering (docs/SERVING.md §Prefix cache and
+    # tiering): serving_prefix_cache toggles copy-on-write shared-prefix KV
+    # pages (on by default); serving_hibernate_after_s > 0 tiers cached
+    # prefixes idle past the threshold into the worker's host-RAM cold
+    # arena (0 = never hibernate)
+    serving_prefix_cache: bool = True
+    serving_hibernate_after_s: float = 0.0
 
 
 @dataclass
@@ -157,6 +164,10 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
             serving_prefill_budget=int(p.get("serving_prefill_budget") or 0),
             serving_role=str(p.get("serving_role") or ""),
             serving_handoff_tokens=int(p.get("serving_handoff_tokens") or 0),
+            serving_prefix_cache=bool(p.get("serving_prefix_cache", True)),
+            serving_hibernate_after_s=float(
+                p.get("serving_hibernate_after_s") or 0.0
+            ),
         )
     for topic, pools in (doc.get("topics") or {}).items():
         if isinstance(pools, str):

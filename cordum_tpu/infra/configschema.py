@@ -71,6 +71,12 @@ POOLS_SCHEMA: dict[str, Any] = {
                             "enum": ["prefill", "decode", "mixed", ""],
                         },
                         "serving_handoff_tokens": _NONNEG_INT,
+                        # prefix cache + session tiering (docs/SERVING.md
+                        # §Prefix cache and tiering): CoW shared-prefix KV
+                        # toggle + idle seconds before a cached prefix is
+                        # hibernated to the host-RAM cold arena (0 = never)
+                        "serving_prefix_cache": {"type": "boolean"},
+                        "serving_hibernate_after_s": _NONNEG,
                     },
                     "additionalProperties": False,
                 }],

@@ -499,6 +499,56 @@ class Metrics:
             "hot worker; no_sessions = nothing movable, e.g. every "
             "candidate was cooldown-immune)",
         )
+        # prefix cache + session tiering (docs/SERVING.md §Prefix cache and
+        # tiering, ISSUE 18): shared-prefix admission outcomes, pages the
+        # radix cache retains, CoW activity, and the hibernate/restore flow
+        # that tiers idle resident sessions to the host-RAM cold arena
+        self.serving_prefix = Counter(
+            "cordum_serving_prefix_total",
+            "Prefix-cache admission outcomes (hit = the session's prompt "
+            "matched cached full pages and skipped their prefill; miss = "
+            "admitted cold)",
+        )
+        self.serving_prefix_tokens = Counter(
+            "cordum_serving_prefix_tokens_total",
+            "Prompt tokens whose prefill was skipped via shared-prefix "
+            "KV pages",
+        )
+        self.serving_prefix_pages = Gauge(
+            "cordum_serving_prefix_cached_pages",
+            "Physical arena pages currently retained (warm) by the prefix "
+            "cache",
+        )
+        self.serving_prefix_evictions = Counter(
+            "cordum_serving_prefix_evictions_total",
+            "Cached-prefix pages dropped, by reason (capacity = LRU-evicted "
+            "under admission exhaustion; stale = replaced by a fresher "
+            "registration)",
+        )
+        self.serving_cow_copies = Counter(
+            "cordum_serving_cow_copies_total",
+            "Copy-on-write page duplications (a session wrote into a page "
+            "another table still maps)",
+        )
+        self.serving_hibernate = Counter(
+            "cordum_serving_hibernate_total",
+            "Session-tiering transitions, by event (hibernated = pages "
+            "exported to the cold arena and released; restored = pages "
+            "re-imported on the next turn; dropped = cold state discarded)",
+        )
+        self.serving_hibernate_pause = Histogram(
+            "cordum_serving_hibernate_pause_seconds",
+            "Wall time a turn waits on a cold-arena restore (page alloc + "
+            "scatter) before its prefill can start",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     2.5),
+        )
+        self.serving_resident_sessions = Gauge(
+            "cordum_serving_resident_sessions",
+            "Conversations with restorable KV state on this worker, by tier "
+            "(warm = pages resident in the device arena; cold = records in "
+            "the host-RAM cold arena)",
+        )
         self.session_failovers = Counter(
             "cordum_sched_session_failovers_total",
             "In-flight jobs re-dispatched to a new worker, by reason "
@@ -664,6 +714,14 @@ class Metrics:
             self.session_affinity,
             self.serving_migrations,
             self.serving_migration_pause,
+            self.serving_prefix,
+            self.serving_prefix_tokens,
+            self.serving_prefix_pages,
+            self.serving_prefix_evictions,
+            self.serving_cow_copies,
+            self.serving_hibernate,
+            self.serving_hibernate_pause,
+            self.serving_resident_sessions,
             self.session_failovers,
             self.spans_dropped,
             self.telemetry_snapshots,

@@ -248,6 +248,22 @@ def forward(
 # padding rows and padded page-table tails point at it, so their writes land
 # harmlessly in slots no live sequence ever attends to (the causal mask cuts
 # every k_pos > position).
+#
+# Page aliasing invariants (docs/SERVING.md §Prefix cache and tiering): the
+# attention gather walks ONLY the row of ``page_tables`` handed to it for
+# each sequence, so two tables may point at the SAME physical page and the
+# kernel cannot tell — physical-page aliasing is free here, which is what
+# makes copy-on-write prefix sharing a pure control-plane feature.  The
+# contract the serving layer must keep for an aliased page:
+#   * read-only — a write lands in every table that maps the page, so the
+#     engine CoW-copies (``copy_kv_page``) before any position inside a
+#     shared page is written;
+#   * identical logical prefix — a page's K/V depends on every position
+#     before it (attention), so a page may only be shared between
+#     sequences whose token ids agree on [0, end_of_page).
+# The allocator's refcount table (serving/pager.py) enforces the lifetime
+# half: an aliased page cannot return to the free list while any table
+# still maps it.
 
 
 def init_kv_pages(
@@ -271,6 +287,26 @@ def _gather_page(pages: jax.Array, pid: jax.Array) -> jax.Array:
 @jax.jit
 def _scatter_page(pages: jax.Array, pid: jax.Array, block: jax.Array) -> jax.Array:
     return jax.lax.dynamic_update_index_in_dim(pages, block, pid, axis=1)
+
+
+@jax.jit
+def _copy_page(pages: jax.Array, src: jax.Array, dst: jax.Array) -> jax.Array:
+    return jax.lax.dynamic_update_index_in_dim(
+        pages,
+        jax.lax.dynamic_index_in_dim(pages, src, axis=1, keepdims=False),
+        dst, axis=1,
+    )
+
+
+def copy_kv_page(
+    k_pages: jax.Array, v_pages: jax.Array, src: int, dst: int
+) -> tuple[jax.Array, jax.Array]:
+    """Duplicate one arena page on device — the copy-on-write half of
+    prefix sharing (docs/SERVING.md §Prefix cache and tiering).  Both
+    indices are traced operands, so every CoW of every session reuses the
+    same cached executable; the copy never leaves the device (no host
+    round trip, unlike the migration gather/scatter pair)."""
+    return _copy_page(k_pages, src, dst), _copy_page(v_pages, src, dst)
 
 
 def gather_kv_pages(

@@ -403,12 +403,15 @@ _CAP_COLS = (
     ("fresh", "fresh"),
 )
 
-# per-worker serving-state columns (docs/SERVING.md §Disaggregation): the
-# beacons already carry the KV arena, decode occupancy, role and drain
+# per-worker serving-state columns (docs/SERVING.md §Disaggregation and
+# §Prefix cache and tiering): the beacons already carry the KV arena,
+# decode occupancy, prefix-cache residency, session tiers, role and drain
 # flag — this table surfaces them next to the throughput matrix
 _WORKER_COLS = (
     ("worker", "worker"), ("role", "role"), ("kv_free", "kv_free"),
     ("kv_used", "kv_used"), ("sessions", "sessions"), ("occ", "occ"),
+    ("pfx_pages", "pfx_pages"), ("pfx_hit", "pfx_hit"),
+    ("resident", "resident"), ("hib", "hib"),
     ("draining", "draining"), ("fresh", "fresh"),
 )
 
@@ -435,6 +438,12 @@ def render_worker_table(workers: dict) -> list[str]:
         occ = w.get("occupancy") or {}
         if not kv and not occ and not w.get("serving_role"):
             continue
+        # prefix-cache + tiering fields ride the same beacons; workers
+        # without the cache (or older beacons) render "-"
+        resident = "-"
+        if "resident_warm" in occ or "resident_cold" in occ:
+            resident = (f"{occ.get('resident_warm', 0)}w/"
+                        f"{occ.get('resident_cold', 0)}c")
         rows.append({
             "worker": str(wid),
             "role": str(w.get("serving_role") or "mixed"),
@@ -442,6 +451,11 @@ def render_worker_table(workers: dict) -> list[str]:
             "kv_used": str(kv.get("pages_in_use", "-")),
             "sessions": str(occ.get("active_sessions", "-")),
             "occ": f"{occ.get('decode_mean', 0.0):g}",
+            "pfx_pages": str(kv.get("prefix_pages", "-")),
+            "pfx_hit": (f"{occ['prefix_hit_rate']:.0%}"
+                        if "prefix_hit_rate" in occ else "-"),
+            "resident": resident,
+            "hib": str(occ.get("hibernated_sessions", "-")),
             "draining": "yes" if w.get("draining") else "no",
             "fresh": "yes" if w.get("fresh", True) else "no",
         })

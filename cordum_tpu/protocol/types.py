@@ -396,7 +396,7 @@ class SessionMoved(WireModel):
     session_key: str = ""
     from_worker: str = ""
     to_worker: str = ""
-    reason: str = ""  # handoff | rebalance | drain
+    reason: str = ""  # handoff | rebalance | drain | hibernated | restored
 
 
 @dataclass

@@ -260,6 +260,22 @@ class LlamaServingBackend:
                 self._k_pages, self._v_pages, ids, blocks
             )
 
+    def copy_page(self, src: int, dst: int) -> None:
+        """Duplicate physical page ``src`` into ``dst`` on device — the
+        copy-on-write half of prefix sharing (docs/SERVING.md §Prefix
+        cache and tiering).  The engine calls this before any position
+        inside a shared page would be written: the writer gets its own
+        copy, every other table keeps attending to the original.  One
+        cached executable serves every CoW (traced page indices).
+        Blocking; call from an executor thread."""
+        self._ensure()
+        from ..models import llama
+
+        with self._dev_lock:
+            self._k_pages, self._v_pages = llama.copy_kv_page(
+                self._k_pages, self._v_pages, src, dst
+            )
+
     # ------------------------------------------------------------------
     # compat conveniences over step() — tests and benches drive these; the
     # engine always assembles mixed steps itself.  Both ride the SAME

@@ -39,6 +39,8 @@ from ..infra.metrics import Metrics
 from ..obs.tracer import Tracer
 from .backend import StepEntry
 from .pager import CacheExhausted, PageAllocator
+from .prefixcache import PrefixCache, PrefixNode
+from .tiering import SessionTiering
 
 # on_tokens(new_tokens, n_generated, done) — the streaming sink
 TokenSink = Callable[[list[int], int, bool], Awaitable[None]]
@@ -70,6 +72,14 @@ class SessionRequeued(Exception):
     non-terminal ``SESSION_REQUEUE`` result and the scheduler re-dispatches
     with the already-streamed tokens as a forced-decode prefix — bounded by
     the attempts counter, FAILED only past the cap."""
+
+
+class SessionHibernated(Exception):
+    """Session frozen whole and tiered into the host-RAM cold arena
+    (docs/SERVING.md §Prefix cache and tiering): a later
+    ``restore_hibernated`` on this worker owns the token stream and the
+    terminal result — the local waiter publishes NOTHING (the live-
+    migration contract, pointed at ourselves)."""
 
 
 @dataclass
@@ -106,6 +116,12 @@ class ServingStats:
     migrated_out: int = 0  # sessions live-migrated to a peer worker
     migrated_in: int = 0  # sessions adopted from a peer worker
     requeued: int = 0  # sessions handed back to the scheduler for failover
+    prefix_hits: int = 0  # admissions that mapped cached shared-prefix pages
+    prefix_misses: int = 0
+    prefix_hit_tokens: int = 0  # prompt tokens whose prefill was skipped
+    cow_copies: int = 0  # copy-on-write page duplications
+    hibernated_out: int = 0  # live sessions tiered whole to the cold arena
+    restored_in: int = 0  # live sessions restored from the cold arena
     occupancy_sum: int = 0
     max_occupancy: int = 0
     admission_waits: int = 0  # admissions delayed by cache exhaustion
@@ -186,6 +202,8 @@ class ServingEngine:
         capacity: Optional[Any] = None,
         handoff_threshold_tokens: int = 0,
         migrate_in_cooldown_s: float = 30.0,
+        prefix_cache: bool = True,
+        hibernate_after_s: float = 0.0,
     ) -> None:
         self.backend = backend
         self.run_blocking = run_blocking  # worker.run_in_executor
@@ -225,6 +243,31 @@ class ServingEngine:
             self.step_tokens,
         )
         self.allocator = PageAllocator(backend.num_pages, backend.page_size)
+        # prefix cache + session tiering (docs/SERVING.md §Prefix cache and
+        # tiering): the radix index over cached full-page prefixes, and the
+        # hibernate/restore machinery that tiers idle resident state to the
+        # host-RAM cold arena.  hibernate_after_s <= 0 disables the sweep
+        # (the cache still shares; pressure is handled by LRU eviction).
+        # Sharing also requires the backend's page-copy primitive (CoW):
+        # without one a shared page could never be duplicated on divergent
+        # write, so the cache is disabled outright rather than half-armed —
+        # arena-less test fakes recompute K/V from the tokens actually fed,
+        # so a silent prefill skip would change their outputs.
+        can_share = prefix_cache and callable(getattr(backend, "copy_page", None))
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(self.allocator, metrics=metrics)
+            if can_share else None
+        )
+        self.tiering: Optional[SessionTiering] = (
+            SessionTiering(
+                self.prefix,
+                hibernate_after_s=hibernate_after_s,
+                export_page=self._export_prefix_page,
+                metrics=metrics,
+            )
+            if self.prefix is not None else None
+        )
+        self._tiering_task: Optional[asyncio.Task] = None
         self.stats = ServingStats()
         self._pending: deque[_Session] = deque()
         self._active: dict[str, _Session] = {}
@@ -362,6 +405,35 @@ class ServingEngine:
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.ensure_future(self._decode_loop())
             self._loop_task.add_done_callback(self._on_loop_done)
+        if (
+            self.tiering is not None and self.tiering.hibernate_after_s > 0
+            and (self._tiering_task is None or self._tiering_task.done())
+        ):
+            self._tiering_task = asyncio.ensure_future(self._tiering_loop())
+
+    async def _tiering_loop(self) -> None:
+        """Periodic hibernate sweep — its own task because idle resident
+        conversations are exactly the ones generating no steps: the decode
+        loop is parked on its wake event while they cool down."""
+        assert self.tiering is not None
+        interval = max(0.05, min(1.0, self.tiering.hibernate_after_s / 4))
+        while not self._closed:
+            await asyncio.sleep(interval)
+            if self._closed:
+                return
+            try:
+                await self.tiering.sweep()
+            except Exception as e:  # noqa: BLE001 - sweep is best-effort
+                logx.warn("hibernate sweep failed", err=str(e))
+
+    async def _export_prefix_page(self, page: int) -> Optional[dict]:
+        """One full arena page as a PR 12 migration record — the tiering
+        sweep's export half (None = the backend has no arena to export)."""
+        fn = getattr(self.backend, "export_kv", None)
+        if fn is None:
+            return None
+        recs = await self.run_blocking(fn, [page], 0, self.allocator.page_size)
+        return recs[0] if recs else None
 
     def _on_loop_done(self, task: asyncio.Task) -> None:
         """Step failures are handled inside the loop; anything that still
@@ -387,11 +459,19 @@ class ServingEngine:
             self.metrics.serving_sessions.set(float(len(self._active)))
             self.metrics.serving_kv_pages_in_use.set(float(self.allocator.used_pages))
 
-    def _admit(self) -> None:
+    async def _admit(self) -> None:
         """Move pending sessions straight into the step loop while pages
         and session slots allow; FIFO so exhaustion delays but never
         reorders admission.  An admitted session needs no separate prefill
-        phase — its prompt chunks ride the next steps' token budget."""
+        phase — its prompt chunks ride the next steps' token budget.
+
+        Prefix-cache hook (docs/SERVING.md §Prefix cache and tiering): the
+        longest cached page-aligned prefix of the prompt maps its physical
+        pages straight into the new session's table — prefill starts at
+        the divergence point.  Cold nodes on the hit path restore from the
+        host-RAM arena first (the hibernate restore), and exhaustion
+        LRU-evicts zero-refcount cached prefixes before the head-of-line
+        admission gives up and waits."""
         while self._pending and len(self._active) < self.max_sessions:
             sess = self._pending[0]
             if sess.cancelled:
@@ -401,13 +481,63 @@ class ServingEngine:
             footprint = self.allocator.pages_for(
                 len(sess.req.prompt) + sess.req.max_new_tokens
             )
+            shared: list[int] = []
+            hit_tokens = 0
+            if self.prefix is not None and not sess.req.resume_tokens:
+                nodes = await self._restore_nodes(
+                    self.prefix.match(sess.prefill_seq)
+                )
+                if self._closed:
+                    break  # stop() raced the restore await
+                if sess.cancelled:
+                    continue  # loop head pops + retires it
+                # keep only the unbroken warm head of the path (a restore
+                # may have truncated it, or an eviction raced the await)
+                for node in nodes:
+                    if node.dropped or not node.warm:
+                        break
+                    shared.append(node.page)
+                # at least one token must be fed through prefill so the
+                # completing chunk has a position to sample from; a hit
+                # ending exactly at the prompt end re-feeds the final
+                # token into shared territory (the CoW guard copies that
+                # page before the step writes it)
+                hit_tokens = min(
+                    len(shared) * self.allocator.page_size,
+                    len(sess.prefill_seq) - 1,
+                )
+                if hit_tokens < 1:
+                    shared, hit_tokens = [], 0
             try:
-                pages = self.allocator.alloc(sess.job_id, footprint)
+                pages = self._alloc_with_evict(
+                    sess.job_id, footprint - len(shared), shared
+                )
             except CacheExhausted:
                 self.stats.admission_waits += 1
                 break  # head-of-line waits for a retirement to free pages
             self._pending.popleft()
             sess.pages = pages
+            if hit_tokens > 0:
+                # the skipped positions' K/V already sits in the shared
+                # pages (identical token prefix ⇒ identical K/V — the
+                # radix path IS the key); chunked prefill picks up at the
+                # divergence point via prefill_pos
+                sess.prefill_pos = hit_tokens
+                sess.pos = hit_tokens
+                self.stats.prefix_hits += 1
+                self.stats.prefix_hit_tokens += hit_tokens
+                self.prefix.stats.hits += 1
+                self.prefix.stats.hit_tokens += hit_tokens
+                if self.metrics is not None:
+                    self.metrics.serving_prefix.inc(outcome="hit")
+                    self.metrics.serving_prefix_tokens.inc(float(hit_tokens))
+            elif self.prefix is not None and not sess.req.resume_tokens:
+                self.stats.prefix_misses += 1
+                self.prefix.stats.misses += 1
+                if self.metrics is not None:
+                    self.metrics.serving_prefix.inc(outcome="miss")
+            if self.tiering is not None and sess.req.session_key:
+                self.tiering.touch(sess.req.session_key)
             self._active[sess.job_id] = sess
             self.stats.admitted += 1
             if self.metrics is not None:
@@ -423,6 +553,79 @@ class ServingEngine:
                 # decode — finish straight from the resume prefix
                 self._retire(sess)
 
+    def _alloc_with_evict(
+        self, owner: str, n_fresh: int, shared: list[int]
+    ) -> list[int]:
+        """Admission alloc with the exhaustion hook: LRU-evict cached
+        prefixes only the cache still references to cover the shortfall,
+        then retry once.  The hit path's own pages are shielded with an
+        extra reference while evicting, so the eviction scan can never
+        free a page this very admission is about to map."""
+        try:
+            return self.allocator.alloc(owner, n_fresh, shared=shared)
+        except CacheExhausted:
+            if self.prefix is None:
+                raise
+            need = n_fresh - self.allocator.free_pages
+            if shared:
+                self.allocator.retain(shared)
+            try:
+                if self.prefix.evict(need) < need:
+                    raise
+                return self.allocator.alloc(owner, n_fresh, shared=shared)
+            finally:
+                if shared:
+                    self.allocator.release(shared)
+
+    async def _restore_nodes(
+        self, nodes: list[PrefixNode]
+    ) -> list[PrefixNode]:
+        """Re-warm the cold nodes on a matched path (hibernate restore):
+        allocate a fresh page, scatter the host-RAM record back, promote.
+        The path truncates at the first node that cannot restore (no
+        import support, exhaustion even after eviction, or an eviction
+        racing the scatter).  The pause — what the turn waits before its
+        prefill can start — feeds
+        ``cordum_serving_hibernate_pause_seconds``."""
+        out: list[PrefixNode] = []
+        t0 = None
+        imp = getattr(self.backend, "import_kv", None)
+        for node in nodes:
+            if node.dropped:
+                break
+            if node.warm:
+                out.append(node)
+                continue
+            if imp is None or node.record is None or self.prefix is None:
+                break
+            if t0 is None:
+                t0 = time.monotonic()
+            try:
+                (page,) = self.allocator.alloc_raw(1)
+            except CacheExhausted:
+                if self.prefix.evict(1) < 1:
+                    break
+                try:
+                    (page,) = self.allocator.alloc_raw(1)
+                except CacheExhausted:
+                    break
+            try:
+                await self.run_blocking(imp, [page], [dict(node.record, i=0)])
+            except Exception as e:  # noqa: BLE001 - keep the record, skip the hit
+                self.allocator.release([page])
+                logx.warn("prefix restore failed", err=str(e))
+                break
+            if node.dropped:
+                self.allocator.release([page])
+                break
+            self.prefix.promote(node, page)
+            if self.tiering is not None:
+                self.tiering.stats.restored_pages += 1
+            out.append(node)
+        if t0 is not None and self.metrics is not None:
+            self.metrics.serving_hibernate_pause.observe(time.monotonic() - t0)
+        return out
+
     async def _emit(self, sess: _Session, new_tokens: list[int]) -> None:
         if sess.on_tokens is None:
             return
@@ -432,6 +635,22 @@ class ServingEngine:
             logx.warn("token stream sink failed", job_id=sess.job_id, err=str(e))
 
     def _retire(self, sess: _Session, error: Optional[BaseException] = None) -> None:
+        if (
+            error is None and self.prefix is not None
+            and not self._closed and not sess.cancelled and sess.pages
+        ):
+            # retain the finished conversation's full pages under their
+            # token path: the next turn (same history + new suffix) maps
+            # them instead of re-prefilling.  Register BEFORE the
+            # allocator drops the session's references, so a shared page
+            # never transits the free list (the retain/release ordering
+            # the property suite pins down).  Positions [0, pos) were
+            # written; their tokens are prompt + generated output minus
+            # the never-fed final sample.
+            covered = (sess.req.prompt + sess.out_tokens)[:sess.pos]
+            self.prefix.register(covered, sess.pages)
+            if self.tiering is not None and sess.req.session_key:
+                self.tiering.note_turn(sess.req.session_key, covered)
         self.allocator.free(sess.job_id)
         self._active.pop(sess.job_id, None)
         if error is None:
@@ -447,6 +666,9 @@ class ServingEngine:
             elif isinstance(error, SessionMigrated):
                 reason = "migrated"
                 self.stats.migrated_out += 1
+            elif isinstance(error, SessionHibernated):
+                reason = "hibernated"
+                self.stats.hibernated_out += 1
             elif isinstance(error, SessionRequeued):
                 reason = "requeued"
                 self.stats.requeued += 1
@@ -458,11 +680,85 @@ class ServingEngine:
                 sess.future.set_exception(error)
 
     # ------------------------------------------------------------------
-    def _assemble(self) -> tuple[list[StepEntry], list[tuple[_Session, int, bool]]]:
+    async def _resolve_cow(self) -> frozenset[str]:
+        """Copy-on-write guard (docs/SERVING.md §Prefix cache and
+        tiering): before assembling a step, any page a session is about
+        to WRITE that another table — or the prefix cache — still
+        references is duplicated onto a fresh page and swapped into this
+        session's table only.  Full-page-only caching makes the trigger
+        rare (a prefix hit ending exactly at the prompt end re-feeds one
+        token into shared territory), but the guard is what makes sharing
+        safe by construction instead of by keying convention.  Returns
+        job ids that must sit this step out (no fresh page even after
+        dropping the cache's own reference)."""
+        skip: set[str] = set()
+        ps = self.allocator.page_size
+        for sess in list(self._active.values()):
+            if sess.frozen or sess.cancelled or sess.job_id not in self._active:
+                continue
+            if sess.prefilled:
+                write_pages = range(sess.pos // ps, sess.pos // ps + 1)
+            else:
+                lo = sess.prefill_pos // ps
+                hi = min(
+                    len(sess.prefill_seq) - 1,
+                    sess.prefill_pos + self.step_tokens - 1,
+                ) // ps
+                write_pages = range(lo, hi + 1)
+            for idx in write_pages:
+                if idx >= len(sess.pages):
+                    break
+                if self.allocator.refcount(sess.pages[idx]) <= 1:
+                    continue
+                if not await self._cow(sess, idx):
+                    skip.add(sess.job_id)
+                    break
+        return frozenset(skip)
+
+    async def _cow(self, sess: _Session, idx: int) -> bool:
+        """Give ``sess`` a private copy of page-table slot ``idx``.
+        Cheapest first: under exhaustion (or when the cache is the only
+        other holder left) dropping the cache's reference may already
+        make this session the sole owner — no copy, no fresh page."""
+        old = sess.pages[idx]
+        copy = getattr(self.backend, "copy_page", None)
+        if copy is None:
+            # arena-less backends (test fakes) have no page contents to
+            # copy and no way to share them — nothing to protect
+            return True
+        if self.allocator.free_pages < 1 and self.prefix is not None:
+            self.prefix.drop_subtree(old)
+            if self.allocator.refcount(old) <= 1:
+                return True
+        try:
+            (fresh,) = self.allocator.alloc_raw(1)
+        except CacheExhausted:
+            if self.prefix is not None:
+                self.prefix.drop_subtree(old)
+                if self.allocator.refcount(old) <= 1:
+                    return True
+            return False
+        await self.run_blocking(copy, old, fresh)
+        if sess.cancelled or sess.job_id not in self._active:
+            self.allocator.release([fresh])  # retired during the copy
+            return True
+        self.allocator.swap_owned(sess.job_id, old, fresh)
+        sess.pages[idx] = fresh
+        self.allocator.release([old])
+        self.stats.cow_copies += 1
+        if self.metrics is not None:
+            self.metrics.serving_cow_copies.inc()
+        return True
+
+    # ------------------------------------------------------------------
+    def _assemble(
+        self, skip: frozenset = frozenset()
+    ) -> tuple[list[StepEntry], list[tuple[_Session, int, bool]]]:
         """Build one mixed step: a decode row for every prefilled session,
         then prompt chunks for prefilling ones (admission order) within the
         flat token budget and the per-step chunk cap.  Returns the entries
-        plus aligned ``(session, chunk_len, samples)`` bookkeeping."""
+        plus aligned ``(session, chunk_len, samples)`` bookkeeping.
+        ``skip`` rows sit this step out (CoW starved for a fresh page)."""
         entries: list[StepEntry] = []
         rows: list[tuple[_Session, int, bool]] = []
         budget = self.step_tokens
@@ -470,7 +766,7 @@ class ServingEngine:
         for sess in self._active.values():
             # frozen = mid-migration freeze-and-delta: the session's pages
             # are being shipped; its rows sit this step (and the next) out
-            if not sess.prefilled or sess.frozen:
+            if not sess.prefilled or sess.frozen or sess.job_id in skip:
                 continue
             entries.append(StepEntry(
                 tokens=[sess.last_token], start=sess.pos, pages=sess.pages,
@@ -485,7 +781,7 @@ class ServingEngine:
         # batch prompt ingestion is deprioritized (docs/ADMISSION.md)
         prefilling = [
             s for s in self._active.values()
-            if not s.prefilled and not s.frozen
+            if not s.prefilled and not s.frozen and s.job_id not in skip
         ]
         prefilling.sort(
             key=lambda s: 0 if s.req.job_class in INTERACTIVE_CLASSES else 1
@@ -519,7 +815,7 @@ class ServingEngine:
         every active session — decode rows and prefill chunks mixed;
         admission and retirement happen between steps, never inside one."""
         while not self._closed:
-            self._admit()
+            await self._admit()
             # evict cancellations before assembling the batch
             for sess in [s for s in self._active.values() if s.cancelled]:
                 self._retire(sess, error=SessionCancelled(sess.job_id))
@@ -536,7 +832,7 @@ class ServingEngine:
                 else:
                     await asyncio.sleep(0.001)  # pages freeing: poll soon
                 continue
-            entries, rows = self._assemble()
+            entries, rows = self._assemble(await self._resolve_cow())
             if not entries:  # defensive: all rows parked past the budget
                 await asyncio.sleep(0.001)
                 continue
@@ -781,6 +1077,84 @@ class ServingEngine:
         self._retire(sess, error=SessionMigrated(job_id))
         return True
 
+    async def hibernate_session(self, job_id: str) -> bool:
+        """Freeze a live session and tier it whole into the host-RAM cold
+        arena — the local analogue of live migration (same record format,
+        no peer): freeze → quiesce → export state + pages → retire
+        ``reason="hibernated"``.  The submit waiter gets
+        :class:`SessionHibernated` and publishes nothing;
+        :meth:`restore_hibernated` later owns the token stream and the
+        terminal result.  False when the session is not live here (or
+        tiering is disabled)."""
+        if self.tiering is None:
+            return False
+        meta = self.describe_session(job_id)
+        if meta is None or not self.freeze_session(job_id):
+            return False
+        try:
+            await self.wait_quiesced(job_id)
+            state = self.export_state(job_id)
+            if state is None:
+                return False
+            records = await self.export_pages(job_id, 0, int(state["pos"]))
+        except BaseException:
+            self.unfreeze_session(job_id)
+            raise
+        sess = self._active.get(job_id)
+        if sess is None or sess.cancelled:
+            self.unfreeze_session(job_id)
+            return False
+        self.tiering.arena.put(job_id, {
+            "meta": meta, "state": state, "records": records,
+        })
+        self._retire(sess, error=SessionHibernated(job_id))
+        if self.metrics is not None:
+            self.metrics.serving_hibernate.inc(event="hibernated")
+        return True
+
+    async def restore_hibernated(
+        self,
+        job_id: str,
+        *,
+        on_tokens: Optional[TokenSink] = None,
+    ) -> asyncio.Future:
+        """Re-admit a hibernated session from the cold arena via the
+        existing :meth:`install_session` path; carried tokens replay at
+        offset 0, so offset-deduping stream consumers see an exactly-once
+        sequence across the gap.  Raises ``KeyError`` when the arena has
+        no such session; on install failure (exhaustion) the cold doc is
+        put back, restorable later."""
+        if self.tiering is None:
+            raise KeyError(job_id)
+        doc = self.tiering.arena.pop(job_id)
+        if doc is None:
+            raise KeyError(job_id)
+        meta, state = doc["meta"], doc["state"]
+        eos = meta.get("eos_token")
+        req = GenRequest(
+            prompt=[int(t) for t in meta["prompt"]],
+            max_new_tokens=int(meta["max_new_tokens"]),
+            session_key=str(meta.get("session_key", "")),
+            eos_token=int(eos) if isinstance(eos, int) else None,
+            stream=bool(meta.get("stream", True)),
+            resume_tokens=[int(t) for t in meta.get("resume_tokens") or []],
+        )
+        t0 = time.monotonic()
+        try:
+            fut = await self.install_session(
+                req, job_id=job_id, state=state, records=doc["records"],
+                trace_id=str(meta.get("trace_id", "")),
+                on_tokens=on_tokens, origin="hibernate",
+            )
+        except BaseException:
+            self.tiering.arena.put(job_id, doc)
+            raise
+        self.stats.restored_in += 1
+        if self.metrics is not None:
+            self.metrics.serving_hibernate.inc(event="restored")
+            self.metrics.serving_hibernate_pause.observe(time.monotonic() - t0)
+        return fut
+
     def requeue(self, job_id: str, reason: str = "") -> bool:
         """Hand a session (pending or active) back to the scheduler for
         failover — the drain fallback when no peer can take its pages."""
@@ -805,13 +1179,16 @@ class ServingEngine:
         trace_id: str = "",
         parent_span_id: str = "",
         on_tokens: Optional[TokenSink] = None,
+        origin: str = "migration",
     ) -> asyncio.Future:
         """Adopt a migrated-in session: allocate fresh arena blocks,
         scatter the shipped page records into them, and resume decoding
         exactly where the source froze.  Raises (``CacheExhausted`` /
         ``ValueError``) when this worker cannot take it — the source then
         falls back to a scheduler requeue.  Returns the session's result
-        future (token list)."""
+        future (token list).  ``origin="hibernate"`` (the
+        :meth:`restore_hibernated` path) books the adoption under the
+        hibernate counters instead of the migration ones."""
         if self._closed:
             raise RuntimeError("serving engine is stopped")
         if job_id in self._active or any(
@@ -860,10 +1237,12 @@ class ServingEngine:
             restore(job_id, sess.prefill_seq, sess.prefill_pos)
         self._active[job_id] = sess
         self.stats.admitted += 1
-        self.stats.migrated_in += 1
+        if origin == "migration":
+            self.stats.migrated_in += 1
         if self.metrics is not None:
             self.metrics.serving_admitted.inc()
-            self.metrics.serving_migrations.inc(role="in", outcome="ok")
+            if origin == "migration":
+                self.metrics.serving_migrations.inc(role="in", outcome="ok")
         if sess.out_tokens and sess.on_tokens is not None:
             # replay the carried tokens at offset 0: dedupe-by-offset makes
             # it a no-op for clients that saw them and a backfill for
@@ -885,6 +1264,15 @@ class ServingEngine:
         draining them could take unboundedly long."""
         self._closed = True
         self._wake.set()
+        if self._tiering_task is not None:
+            self._tiering_task.cancel()
+            try:
+                await self._tiering_task
+            except asyncio.CancelledError:
+                pass
+            except Exception as e:  # noqa: BLE001 - logged, never swallowed
+                logx.warn("tiering sweep crashed during shutdown", err=str(e))
+            self._tiering_task = None
         for sess in list(self._pending):
             if not sess.future.done():
                 sess.future.set_exception(SessionCancelled(sess.job_id))

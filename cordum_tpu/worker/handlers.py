@@ -408,6 +408,8 @@ def make_serving_engine(
     max_concurrent_prefills: int = 2,
     prefill_budget: int = 16,
     handoff_tokens: int = 0,
+    prefix_cache: bool = True,
+    hibernate_after_s: float = 0.0,
     metrics=None,
 ):
     """Build the worker's continuous-batching serving engine over a paged
@@ -442,6 +444,8 @@ def make_serving_engine(
         max_new_tokens_cap=max_new_tokens,
         max_concurrent_prefills=max_concurrent_prefills,
         handoff_threshold_tokens=handoff_tokens,
+        prefix_cache=prefix_cache,
+        hibernate_after_s=hibernate_after_s,
         metrics=metrics,
         tracer=worker.tracer,
         capacity=worker.capacity,
@@ -462,6 +466,8 @@ def attach_default_tpu_worker(
     serving_max_new_tokens: int = 64,
     serving_prefill_budget: int = 16,
     serving_handoff_tokens: int = 0,
+    serving_prefix_cache: bool = True,
+    serving_hibernate_after_s: float = 0.0,
     gang: bool = True,
     gang_rendezvous_timeout_s: float = 10.0,
     gang_peer_timeout_s: float = 30.0,
@@ -487,6 +493,8 @@ def attach_default_tpu_worker(
             max_new_tokens=serving_max_new_tokens,
             prefill_budget=serving_prefill_budget,
             handoff_tokens=serving_handoff_tokens,
+            prefix_cache=serving_prefix_cache,
+            hibernate_after_s=serving_hibernate_after_s,
             metrics=metrics,
         ))
     if gang:

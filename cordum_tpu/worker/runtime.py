@@ -64,6 +64,7 @@ from ..serving.engine import (
     GenRequest,
     ServingEngine,
     SessionCancelled,
+    SessionHibernated,
     SessionMigrated,
     SessionRequeued,
 )
@@ -250,17 +251,75 @@ class Worker:
         # capacity beacon gauges: KV-page/arena headroom + decode occupancy
         # (read at snapshot time, never on the decode hot path)
         alloc = serving.allocator
-        self.capacity.set_kv_headroom(lambda: {
-            "pages_total": alloc.num_pages - 1,  # page 0 is the null page
-            "pages_free": alloc.free_pages,
-            "pages_in_use": alloc.used_pages,
-        })
+
+        def _kv_headroom() -> dict:
+            doc = {
+                "pages_total": alloc.num_pages - 1,  # page 0 is the null page
+                "pages_free": alloc.free_pages,
+                "pages_in_use": alloc.used_pages,
+            }
+            if serving.prefix is not None:
+                # prefix-cache residency (docs/SERVING.md §Prefix cache and
+                # tiering): cached full-page prefixes still in the device
+                # arena, and cold pages tiered out to host RAM
+                doc["prefix_pages"] = serving.prefix.warm_pages
+                doc["prefix_cold_pages"] = serving.prefix.cold_pages
+            return doc
+
+        self.capacity.set_kv_headroom(_kv_headroom)
         stats = serving.stats
-        self.capacity.set_occupancy(lambda: {
-            "decode_mean": round(stats.mean_occupancy, 3),
-            "decode_max": stats.max_occupancy,
-            "active_sessions": serving.active_sessions(),
-        })
+
+        def _occupancy() -> dict:
+            doc = {
+                "decode_mean": round(stats.mean_occupancy, 3),
+                "decode_max": stats.max_occupancy,
+                "active_sessions": serving.active_sessions(),
+            }
+            if serving.prefix is not None:
+                pf = serving.prefix.stats
+                looked = pf.hits + pf.misses
+                doc["prefix_hits"] = pf.hits
+                doc["prefix_hit_rate"] = (
+                    round(pf.hits / looked, 3) if looked else 0.0
+                )
+            if serving.tiering is not None:
+                warm, cold = serving.tiering.tier_counts()
+                doc["resident_warm"] = warm
+                doc["resident_cold"] = cold
+                doc["hibernated_sessions"] = len(serving.tiering.arena)
+            return doc
+
+        self.capacity.set_occupancy(_occupancy)
+        if serving.tiering is not None:
+            # affinity keepalive (docs/SERVING.md §Prefix cache and tiering):
+            # a hibernated conversation must route back HERE next turn — the
+            # cold record is host-local — so the scheduler pins its affinity
+            # entry past the normal TTL; restoring unpins it again
+            serving.tiering.on_hibernated = (
+                lambda key: self._publish_tier_move(key, "hibernated")
+            )
+            serving.tiering.on_restored = (
+                lambda key: self._publish_tier_move(key, "restored")
+            )
+
+    def _publish_tier_move(self, session_key: str, reason: str) -> None:
+        """Announce a tiering transition for ``session_key`` on the moved
+        subject.  reason="hibernated" makes the scheduler pin the affinity
+        entry (strategy.py SESSION_HIBERNATE_TTL_S); "restored" reverts it
+        to the normal TTL.  Fire-and-forget like the migration
+        announcement — a lost packet only risks a cold re-prefill."""
+        if not session_key:
+            return
+        asyncio.ensure_future(self.bus.publish(
+            subj.SERVING_MOVED,
+            BusPacket.wrap(SessionMoved(
+                job_id="",
+                session_key=session_key,
+                from_worker=self.worker_id,
+                to_worker=self.worker_id,
+                reason=reason,
+            ), sender_id=self.worker_id),
+        ))
 
     @property
     def serving(self) -> Optional[ServingEngine]:
@@ -677,6 +736,8 @@ class Worker:
             result_ptr = await self.store.put_result(job_id, out)
         except SessionMigrated:
             return  # chained onward migration: the next owner publishes
+        except SessionHibernated:
+            return  # tiered to the cold arena: the restore path publishes
         except SessionRequeued as e:
             await self._publish_requeue(job_id, str(e) or "requeued",
                                         trace_id=trace_id, partition=partition)
@@ -702,6 +763,33 @@ class Worker:
             subj.stamped_result_subject(partition),
             BusPacket.wrap(res, trace_id=trace_id, sender_id=self.worker_id),
         )
+
+    async def restore_session(self, job_id: str, *, trace_id: str = "") -> bool:
+        """Thaw a live session hibernated by ``ServingEngine.hibernate_session``
+        and resume publishing its stream + terminal result from this worker
+        (the half the hibernate retirement deliberately skipped).  Returns
+        False when the cold arena holds no such session."""
+        serving = self._serving
+        if serving is None or serving.tiering is None:
+            return False
+        doc = serving.tiering.arena.get(job_id)
+        if doc is None:
+            return False
+        meta = doc.get("meta") or {}
+        eos = meta.get("eos_token")
+        gen = GenRequest(
+            prompt=[int(t) for t in meta.get("prompt") or []],
+            max_new_tokens=int(meta.get("max_new_tokens", 16) or 16),
+            session_key=str(meta.get("session_key", "") or ""),
+            eos_token=int(eos) if isinstance(eos, int) else None,
+            stream=bool(meta.get("stream", True)),
+            resume_tokens=[int(t) for t in meta.get("resume_tokens") or []],
+        )
+        fut = await serving.restore_hibernated(
+            job_id, on_tokens=self._token_sink(job_id, gen)
+        )
+        asyncio.ensure_future(self._finish_adopted(job_id, gen, trace_id, fut))
+        return True
 
     async def _publish_requeue(
         self, job_id: str, reason: str, *, trace_id: str = "", partition: str = ""
@@ -872,6 +960,7 @@ class Worker:
         error_code = error_message = ""
         result_ptr = ""
         migrated = False
+        hibernated = False
         requeue_reason = ""
         if gen_req is not None:
             # remembered for drain-time migration (the commit frame carries
@@ -930,6 +1019,8 @@ class Worker:
             error_code, error_message = "CANCELLED", "cancelled"
         except SessionMigrated:
             migrated = True  # the target worker owns stream + result now
+        except SessionHibernated:
+            hibernated = True  # cold arena owns it; restore publishes
         except SessionRequeued as e:
             requeue_reason = str(e) or "requeued"
         except asyncio.CancelledError:
@@ -943,17 +1034,21 @@ class Worker:
             self._active.pop(req.job_id, None)
             self._mark_idle()
         self._session_partition.pop(req.job_id, None)
-        if migrated or requeue_reason:
-            # neither outcome is terminal here: a migrated session's target
-            # publishes everything; a requeued one goes back to the
-            # scheduler as a non-terminal SESSION_REQUEUE result — no
-            # completed-cache entry, so a later redelivery can re-run it
-            if not migrated:
+        if migrated or hibernated or requeue_reason:
+            # none of these outcomes is terminal here: a migrated session's
+            # target publishes everything; a hibernated one publishes from
+            # the restore path (restore_session); a requeued one goes back
+            # to the scheduler as a non-terminal SESSION_REQUEUE result —
+            # no completed-cache entry, so a later redelivery can re-run it
+            if not migrated and not hibernated:
                 await self._publish_requeue(
                     req.job_id, requeue_reason, trace_id=trace_id,
                     partition=(req.labels or {}).get(LABEL_PARTITION, ""),
                 )
-            exec_span.attrs["status"] = "MIGRATED" if migrated else "REQUEUED"
+            exec_span.attrs["status"] = (
+                "MIGRATED" if migrated
+                else "HIBERNATED" if hibernated else "REQUEUED"
+            )
             await self.tracer.finish(exec_span)
             return
         exec_span.attrs["status"] = status

@@ -436,6 +436,13 @@ class Engine:
             labels[LABEL_OP] = op
         if op in SERVING_OPS and LABEL_SESSION_KEY not in labels:
             labels[LABEL_SESSION_KEY] = run_session_key(run)
+        if op in SERVING_OPS and LABEL_BATCH_KEY not in labels:
+            # template co-location (docs/SERVING.md §Prefix cache and
+            # tiering): every run of one workflow template opens with the
+            # same templated prompt, so batch affinity steers their first
+            # turns onto one worker where the radix prefix cache turns the
+            # shared prefill into a hit (later turns ride session affinity)
+            labels[LABEL_BATCH_KEY] = f"wf-tpl:{run.workflow_id}"
         if op in BATCHABLE_OPS and LABEL_BATCH_KEY not in labels:
             labels[LABEL_BATCH_KEY] = op
         env: dict[str, str] = {}

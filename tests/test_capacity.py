@@ -686,9 +686,12 @@ def test_capacity_table_renders_worker_serving_columns():
             "w-dec": {"service": "worker", "fresh": True, "rows": 1,
                       "serving_role": "decode", "draining": True,
                       "kv_pages": {"pages_total": 127, "pages_free": 40,
-                                   "pages_in_use": 87},
+                                   "pages_in_use": 87, "prefix_pages": 12},
                       "occupancy": {"active_sessions": 6,
-                                    "decode_mean": 5.5}},
+                                    "decode_mean": 5.5,
+                                    "prefix_hit_rate": 0.86,
+                                    "resident_warm": 6, "resident_cold": 18,
+                                    "hibernated_sessions": 18}},
             "w-plain": {"service": "worker", "fresh": True, "rows": 1},
         },
         "matrix": [{"op": "llm.generate", "bucket": "28", "worker": "w-dec",
@@ -699,9 +702,22 @@ def test_capacity_table_renders_worker_serving_columns():
     lines = table.splitlines()
     header = next(line for line in lines if "kv_free" in line)
     assert "sessions" in header and "draining" in header and "role" in header
+    assert "pfx_pages" in header and "resident" in header and "hib" in header
     row = next(line for line in lines if line.startswith("w-dec"))
     assert "decode" in row and "40" in row and "87" in row
     assert "6" in row and "yes" in row  # sessions + draining flag
+    # prefix cache + tiering columns (docs/SERVING.md §Prefix cache and
+    # tiering): cached-page count, hit rate, warm/cold census, hibernated
+    assert "12" in row and "86%" in row and "6w/18c" in row
+    # a worker that doesn't beacon the fields degrades to "-" (not a crash)
+    plain_doc = {"workers": {"w-old": {
+        "service": "worker", "fresh": True, "rows": 1,
+        "serving_role": "mixed",
+        "kv_pages": {"pages_total": 64, "pages_free": 60}}},
+        "matrix": [], "ops": {}}
+    old_row = next(line for line in render_capacity_table(plain_doc)
+                   .splitlines() if line.startswith("w-old"))
+    assert old_row.count("-") >= 3  # pfx_pages, pfx_hit, resident, hib
     # a worker with no serving state stays out of the serving section but
     # the matrix still renders
     assert not any(line.startswith("w-plain") and "yes" in line

@@ -313,6 +313,15 @@ _HEALTHY_AGENTS = {
     "agents_reprefills": 0.0, "agents_step_p99_ms": 20.0,
 }
 
+# prefix cache + session tiering (ISSUE 18): the hit pass beat the cold
+# pass token-identically, hibernation held residency above the device
+# arena, and the cold->warm restore actually ran (fast)
+_HEALTHY_CHAT = {
+    "chat_prefix_ttft_speedup": 2.4, "chat_token_identical": 1,
+    "chat_prefix_hit_rate": 0.857, "chat_resident_over_capacity": 1.6,
+    "chat_restored_pages": 8, "chat_restore_pause_p50_ms": 1.0,
+}
+
 
 def test_floor_checker_passes_healthy_doc():
     mod = _floor_mod()
@@ -326,7 +335,8 @@ def test_floor_checker_passes_healthy_doc():
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
-           **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG, **_HEALTHY_AGENTS}
+           **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG,
+           **_HEALTHY_AGENTS, **_HEALTHY_CHAT}
     floors = json.loads((REPO / "bench_floor.json").read_text())
     assert mod.check(doc, floors) == []
 
@@ -346,7 +356,8 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "statebus_replication_overhead_pct": 8.0,
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
-           **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG, **_HEALTHY_AGENTS}
+           **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG,
+           **_HEALTHY_AGENTS, **_HEALTHY_CHAT}
     violations = mod.check(doc, floors)
     assert violations and "value" in violations[0]
     # ceilings guard the other direction (round-trip budget regression)
@@ -387,6 +398,22 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
     doc["prefill_tokens_per_sec"] = 0.0
     assert any("prefill_tokens_per_sec" in v for v in mod.check(doc, floors))
     doc["prefill_tokens_per_sec"] = 850.0
+    # prefix-cache + tiering gates (ISSUE 18): a vanished TTFT win, a
+    # token-divergent hit pass, residency collapsing back to device HBM,
+    # and a restore-pause blowup all fail
+    doc["chat_prefix_ttft_speedup"] = 1.0
+    assert any("chat_prefix_ttft_speedup" in v for v in mod.check(doc, floors))
+    doc["chat_prefix_ttft_speedup"] = 2.4
+    doc["chat_token_identical"] = 0
+    assert any("chat_token_identical" in v for v in mod.check(doc, floors))
+    doc["chat_token_identical"] = 1
+    doc["chat_resident_over_capacity"] = 1.0
+    assert any("chat_resident_over_capacity" in v
+               for v in mod.check(doc, floors))
+    doc["chat_resident_over_capacity"] = 1.6
+    doc["chat_restore_pause_p50_ms"] = 900.0
+    assert any("chat_restore_pause_p50_ms" in v for v in mod.check(doc, floors))
+    doc["chat_restore_pause_p50_ms"] = 1.0
     # end-to-end: main() exits nonzero on a regressed artifact
     bench_json = tmp_path / "bench.json"
     doc["value"] = 100.0
