@@ -1367,6 +1367,12 @@ def _jax_child(device: str) -> None:
     except Exception as ex:  # noqa: BLE001
         out["chat_error"] = f"{type(ex).__name__}: {ex}"[:300]
 
+    # --- self-speculative decoding inside the ragged step (ISSUE 19) ---
+    try:
+        out.update(asyncio.run(_bench_spec(device)))
+    except Exception as ex:  # noqa: BLE001
+        out["spec_error"] = f"{type(ex).__name__}: {ex}"[:300]
+
     print(json.dumps(out), flush=True)
 
 
@@ -1809,6 +1815,106 @@ async def _bench_chat(device: str) -> dict:
         "chat_hibernated_pages": pf.hibernated_pages,
         "chat_restored_pages": pf.restored_pages,
         "chat_restore_pause_p50_ms": round(restore_p50_s * 1000.0, 2),
+    }
+
+
+async def _bench_spec(device: str) -> dict:
+    """Self-speculative decoding inside the ragged step (ISSUE 19): the
+    zero-extra-weights n-gram drafter on a templated agent-style workload —
+    repeated instruction motifs, the pattern tool-call loops and
+    form-filling chains produce — run twice on the real paged backend:
+    once speculation-off (the sequential one-token-per-step baseline), once
+    speculation-on (draft rows verified as k+1-token prefill-shaped rows).
+
+      * ``spec_decode_speedup``: baseline wall / speculative wall for the
+        identical prompt set (floor in bench_floor.json) — static shapes
+        make a k+1-token row cost roughly one step, so the speedup tracks
+        the mean accepted burst length.
+      * ``spec_token_identity``: greedy accept-longest-prefix is a
+        schedule change, not a math change — outputs must match the
+        baseline token-for-token (floor 1.0, i.e. always).
+      * ``spec_compile_count``: draft rows reuse the ONE ragged program
+        (prefill-shaped rows already exist); any second program is a
+        recompile-cliff regression."""
+    from cordum_tpu.infra.metrics import Metrics
+    from cordum_tpu.models import llama
+    from cordum_tpu.serving.backend import LlamaServingBackend
+    from cordum_tpu.serving.engine import GenRequest, ServingEngine
+
+    async def run_blocking(fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    if device == "cpu":
+        lcfg = llama.LlamaConfig.tiny()
+        n_sessions = 4
+    else:
+        lcfg = llama.LlamaConfig(vocab_size=32000, d_model=1024, n_layers=8,
+                                 n_heads=8, n_kv_heads=4, d_ff=3584,
+                                 max_seq_len=512)
+        n_sessions = 8
+    page_size, max_new, draft_k = 8, 80, 4
+    # templated prompts: an 8-token instruction motif repeated 4× plus a
+    # per-session suffix — greedy continuations of this seed settle into
+    # cycles the n-gram drafter predicts near-perfectly, the same shape as
+    # templated agent loops (PAPER.md §workloads)
+    motif = [5, 9, 14, 23, 7, 11, 3, 19]
+    prompts = [motif * 4 + [i + 1] for i in range(n_sessions)]
+
+    async def run_pass(speculative: bool) -> dict:
+        metrics = Metrics()
+        be = LlamaServingBackend(lcfg, num_pages=192, page_size=page_size,
+                                 max_batch_tokens=64, seed=2, metrics=metrics)
+        eng = ServingEngine(be, run_blocking=run_blocking,
+                            max_new_tokens_cap=max_new,
+                            speculative=speculative, draft_k=draft_k,
+                            metrics=metrics)
+        # warm the ragged program so neither pass pays compile in its wall
+        await asyncio.wait_for(eng.submit(
+            GenRequest(prompt=[1, 2, 3], max_new_tokens=2, stream=False),
+            job_id="spec-warm"), timeout=JAX_TIMEOUT_S / 4)
+        steps0, decoded0 = eng.stats.steps, eng.stats.decoded_tokens
+        t0 = time.perf_counter()
+        results = await asyncio.gather(*[
+            asyncio.wait_for(eng.submit(
+                GenRequest(prompt=p, max_new_tokens=max_new, stream=False),
+                job_id=f"spec-{int(speculative)}-{i}"),
+                timeout=JAX_TIMEOUT_S / 2)
+            for i, p in enumerate(prompts)
+        ])
+        wall = time.perf_counter() - t0
+        st = eng.stats
+        out = {
+            "outs": [r["tokens"] for r in results],
+            "wall": wall,
+            "steps": st.steps - steps0,
+            "decoded": st.decoded_tokens - decoded0,
+            "drafted": st.drafted_tokens,
+            "accepted": st.accepted_tokens,
+            "rolled_back": st.rolled_back_tokens,
+            "compiles": be.compiled_programs(),
+        }
+        await eng.stop()
+        return out
+
+    base = await run_pass(False)
+    spec = await run_pass(True)
+    speedup = base["wall"] / spec["wall"] if spec["wall"] else 0.0
+    accept = (spec["accepted"] / spec["drafted"]) if spec["drafted"] else 0.0
+    return {
+        "spec_decode_speedup": round(speedup, 2),
+        "spec_token_identity": int(spec["outs"] == base["outs"]),
+        "spec_accept_rate": round(accept, 3),
+        "spec_decode_tokens_per_s": round(spec["decoded"] / spec["wall"], 1)
+        if spec["wall"] else 0.0,
+        "spec_base_tokens_per_s": round(base["decoded"] / base["wall"], 1)
+        if base["wall"] else 0.0,
+        "spec_steps": spec["steps"],
+        "spec_base_steps": base["steps"],
+        "spec_drafted_tokens": spec["drafted"],
+        "spec_accepted_tokens": spec["accepted"],
+        "spec_rolled_back_tokens": spec["rolled_back"],
+        "spec_compile_count": spec["compiles"],
+        "spec_sessions": n_sessions,
     }
 
 
@@ -2628,6 +2734,10 @@ _CHILD_METRIC_KEYS = (
     "chat_device_session_capacity", "chat_resident_over_capacity",
     "chat_hibernated_pages", "chat_restored_pages",
     "chat_restore_pause_p50_ms",
+    "spec_decode_speedup", "spec_token_identity", "spec_accept_rate",
+    "spec_decode_tokens_per_s", "spec_base_tokens_per_s", "spec_steps",
+    "spec_base_steps", "spec_drafted_tokens", "spec_accepted_tokens",
+    "spec_rolled_back_tokens", "spec_compile_count", "spec_sessions",
 )
 
 
@@ -2692,7 +2802,7 @@ def bench_jax(*, smoke: bool = False) -> dict:
                     results["fallback_device"] = child.get("device", "cpu")
             for k in ("embed_error", "model_error", "batched_error",
                       "serving_error", "disagg_error", "chat_error",
-                      "child_traceback"):
+                      "spec_error", "child_traceback"):
                 if k not in results and k in child:
                     results[k] = child[k]
             if "device" not in results and "device" in child:
@@ -2704,7 +2814,8 @@ def bench_jax(*, smoke: bool = False) -> dict:
                         ("batched_embeds_per_sec", "batched_error"),
                         ("decode_tokens_per_sec", "serving_error"),
                         ("disagg_ttft_p50_ms", "disagg_error"),
-                        ("chat_prefix_ttft_speedup", "chat_error")):
+                        ("chat_prefix_ttft_speedup", "chat_error"),
+                        ("spec_decode_speedup", "spec_error")):
         if metric in results and err in results and results.get("fallback_device"):
             results[f"tpu_{err}"] = results.pop(err)
     return results
@@ -2791,6 +2902,18 @@ def main() -> None:
         out.update(asyncio.run(_bench_chat(
             "cpu" if os.environ.get("JAX_PLATFORMS", "") == "cpu" else "tpu")))
         out["value"] = out.get("chat_prefix_ttft_speedup", 0.0)
+        print(json.dumps(out))
+        return
+    if "--spec" in sys.argv:
+        # speculative-decoding mode (ISSUE 19): the self-drafted
+        # multi-token verification bench — speculation-off vs -on on the
+        # identical templated workload, token-identity gated.  One JSON
+        # line, same spec_* keys as the full bench so bench_floor.json
+        # gates both surfaces.
+        out = {"metric": "spec_decode_speedup", "unit": "x"}
+        out.update(asyncio.run(_bench_spec(
+            "cpu" if os.environ.get("JAX_PLATFORMS", "") == "cpu" else "tpu")))
+        out["value"] = out.get("spec_decode_speedup", 0.0)
         print(json.dumps(out))
         return
     if "--disagg" in sys.argv:
@@ -2958,6 +3081,24 @@ def main() -> None:
         "chat_restored_pages": jx.get("chat_restored_pages", 0),
         "chat_restore_pause_p50_ms": jx.get("chat_restore_pause_p50_ms", 0.0),
         "chat_error": jx.get("chat_error", ""),
+        # self-speculative decoding (ISSUE 19): n-gram drafts verified as
+        # k+1-token rows inside the ONE ragged program — wall speedup on
+        # the templated workload vs the same prompts speculation-off,
+        # token-identity gated (speedup + identity floors and the
+        # compile-count ceiling live in bench_floor.json)
+        "spec_decode_speedup": jx.get("spec_decode_speedup", 0.0),
+        "spec_token_identity": jx.get("spec_token_identity", 0),
+        "spec_accept_rate": jx.get("spec_accept_rate", 0.0),
+        "spec_decode_tokens_per_s": jx.get("spec_decode_tokens_per_s", 0.0),
+        "spec_base_tokens_per_s": jx.get("spec_base_tokens_per_s", 0.0),
+        "spec_steps": jx.get("spec_steps", 0),
+        "spec_base_steps": jx.get("spec_base_steps", 0),
+        "spec_drafted_tokens": jx.get("spec_drafted_tokens", 0),
+        "spec_accepted_tokens": jx.get("spec_accepted_tokens", 0),
+        "spec_rolled_back_tokens": jx.get("spec_rolled_back_tokens", 0),
+        "spec_compile_count": jx.get("spec_compile_count", 0),
+        "spec_sessions": jx.get("spec_sessions", 0),
+        "spec_error": jx.get("spec_error", ""),
         **affinity,
         # overload resilience (ISSUE 13): the multi-tenant storm at ~2×
         # measured capacity — interactive p99 holds, interactive shed ≈ 0,
@@ -2983,13 +3124,13 @@ def main() -> None:
         out["profile"] = prof
     for k in ("fallback_device", "tpu_skipped", "tpu_embed_error",
               "tpu_model_error", "tpu_batched_error", "tpu_serving_error",
-              "tpu_disagg_error", "tpu_chat_error"):
+              "tpu_disagg_error", "tpu_chat_error", "tpu_spec_error"):
         if k in jx:
             out[k] = jx[k]
     degraded = bool(out["embed_error"] or out["model_error"]
                     or out["batched_error"] or out["serving_error"]
                     or out["disagg_error"] or out["chat_error"]
-                    or out.get("gang_error"))
+                    or out["spec_error"] or out.get("gang_error"))
     out["degraded"] = degraded
     if degraded:
         out["child_traceback"] = jx.get("child_traceback", "")

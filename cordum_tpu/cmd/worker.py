@@ -28,6 +28,11 @@ copy-on-write shared-prefix KV pages; WORKER_SERVING_HIBERNATE_AFTER
 (``serving_hibernate_after_s``, seconds) > 0 tiers cached prefixes idle
 past the threshold into the host-RAM cold arena and pins the session's
 scheduler affinity until the next turn restores them.
+Speculative decoding (docs/SERVING.md §Speculative decoding):
+WORKER_SERVING_SPECULATIVE=0 (``serving_speculative``) disables the
+zero-extra-weights n-gram drafter inside the ragged step;
+WORKER_SERVING_DRAFT_K (``serving_draft_k``) caps tokens drafted per
+session per step (0 = engine default).
 
 Graceful drain (docs/SERVING.md §Migration, drain, and failover): SIGTERM
 (unless WORKER_DRAIN_ON_TERM=0) and ``cordumctl drain <worker>`` both put
@@ -145,6 +150,14 @@ async def main() -> None:
         serving_hibernate_after_s=_boot.env_float(
             "WORKER_SERVING_HIBERNATE_AFTER", 0.0)
         or (pool.serving_hibernate_after_s if pool else 0.0),
+        # self-speculative decoding (docs/SERVING.md §Speculative decoding)
+        serving_speculative=(
+            env["WORKER_SERVING_SPECULATIVE"] != "0"
+            if "WORKER_SERVING_SPECULATIVE" in env
+            else (pool.serving_speculative if pool else True)
+        ),
+        serving_draft_k=_boot.env_int("WORKER_SERVING_DRAFT_K", 0)
+        or (pool.serving_draft_k if pool else 0),
         # gang scheduling (docs/GANG.md): member jobs rendezvous + run the
         # SPMD/MPMD step program; WORKER_GANG=0 opts the worker out
         gang=env.get("WORKER_GANG", "1") != "0",

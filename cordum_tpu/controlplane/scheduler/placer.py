@@ -79,12 +79,21 @@ class ServingPlacer:
             role = (hb.labels or {}).get(LABEL_SERVING_ROLE, "")
         return role
 
-    def pick(self, candidates: list[Heartbeat]) -> str:
+    def pick(self, candidates: list[Heartbeat], *,
+             speculable: bool = False) -> str:
         """The worker a new session should prefill on, or ``""`` when the
         view has no measured prefill signal (the caller degrades to its
         ordinary routing).  Score = measured prefill tokens/s (unmeasured
         workers get the median measured rate so they become measured) ×
-        KV-page headroom fraction; distributed by smooth WRR."""
+        KV-page headroom fraction; distributed by smooth WRR.
+
+        ``speculable=True`` (the session carried the ``LABEL_SPECULABLE``
+        hint — templated/repetitive traffic) prefers workers whose
+        capacity beacon reports a speculative acceptance rate: those are
+        the draft-enabled workers that turn the workload's repetition
+        into multi-token verified bursts (docs/SERVING.md §Speculative
+        decoding).  Preference, not a filter — when no draft-enabled
+        worker is live, placement degrades to the ordinary pool."""
         pool = [hb for hb in candidates
                 if not self.view.draining(hb.worker_id)]
         prefill_capable = [
@@ -93,6 +102,13 @@ class ServingPlacer:
         if prefill_capable:
             # decode-roled workers take sessions only when nothing else can
             pool = prefill_capable
+        if speculable:
+            draft_enabled = [
+                hb for hb in pool
+                if self.view.spec_accept(hb.worker_id) is not None
+            ]
+            if draft_enabled:
+                pool = draft_enabled
         if not pool:
             self.fallbacks += 1
             return ""

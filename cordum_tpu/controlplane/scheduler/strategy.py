@@ -45,6 +45,7 @@ from ...protocol.types import (
     LABEL_BATCH_KEY,
     LABEL_OP,
     LABEL_SESSION_KEY,
+    LABEL_SPECULABLE,
     SERVING_OPS,
 )
 
@@ -484,6 +485,12 @@ class LeastLoadedStrategy(Strategy):
         if batch_key:
             sticky = self._affinity_worker(batch_key, pools, job_requires, placement)
             if sticky:
+                if session_akey:
+                    # a session-carrying job routed by its batch key (e.g. a
+                    # workflow turn riding wf-tpl template co-location) must
+                    # still elect its session entry, or every later turn of
+                    # the run re-counts "new" and can never hit
+                    self._record_affinity(session_akey, sticky)
                 return direct_subject(sticky)
 
         # native packed scan (the hot path: no hints, uniform pools)
@@ -618,7 +625,8 @@ class ThroughputAwareStrategy(LeastLoadedStrategy):
                 self._count_session_affinity("hit")
                 return direct_subject(sticky)
         winner = self.placer.pick(
-            self._eligible_workers(req, pools, job_requires)
+            self._eligible_workers(req, pools, job_requires),
+            speculable=bool(labels.get(LABEL_SPECULABLE)),
         )
         if not winner:
             # no counting here: the caller's fallback re-runs the affinity

@@ -98,6 +98,14 @@ class Pool:
     # arena (0 = never hibernate)
     serving_prefix_cache: bool = True
     serving_hibernate_after_s: float = 0.0
+    # self-speculative decoding (docs/SERVING.md §Speculative decoding):
+    # serving_speculative toggles the zero-extra-weights n-gram drafter
+    # inside the ragged step (on by default; harmless when prompts never
+    # repeat — the adaptive throttle collapses draft length to 1);
+    # serving_draft_k caps tokens drafted per session per step (0 = the
+    # engine default)
+    serving_speculative: bool = True
+    serving_draft_k: int = 0
 
 
 @dataclass
@@ -165,6 +173,8 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
             serving_role=str(p.get("serving_role") or ""),
             serving_handoff_tokens=int(p.get("serving_handoff_tokens") or 0),
             serving_prefix_cache=bool(p.get("serving_prefix_cache", True)),
+            serving_speculative=bool(p.get("serving_speculative", True)),
+            serving_draft_k=int(p.get("serving_draft_k") or 0),
             serving_hibernate_after_s=float(
                 p.get("serving_hibernate_after_s") or 0.0
             ),

@@ -76,6 +76,8 @@ POOLS_SCHEMA: dict[str, Any] = {
                         # toggle + idle seconds before a cached prefix is
                         # hibernated to the host-RAM cold arena (0 = never)
                         "serving_prefix_cache": {"type": "boolean"},
+                        "serving_speculative": {"type": "boolean"},
+                        "serving_draft_k": _NONNEG_INT,
                         "serving_hibernate_after_s": _NONNEG,
                     },
                     "additionalProperties": False,

@@ -549,6 +549,24 @@ class Metrics:
             "(warm = pages resident in the device arena; cold = records in "
             "the host-RAM cold arena)",
         )
+        # speculative decoding (docs/SERVING.md §Speculative decoding,
+        # ISSUE 19): the self-drafted verify loop inside the ragged step —
+        # tokens proposed, tokens the model verified, and rejected drafts
+        # whose write positions were rolled back
+        self.serving_spec_drafted = Counter(
+            "cordum_serving_spec_drafted_total",
+            "Speculative tokens proposed into draft verification rows",
+        )
+        self.serving_spec_accepted = Counter(
+            "cordum_serving_spec_accepted_total",
+            "Drafted tokens the ragged step verified and kept (the bonus "
+            "token each verified row also samples is not counted here)",
+        )
+        self.serving_spec_rolled_back = Counter(
+            "cordum_serving_spec_rolled_back_total",
+            "Drafted tokens rejected by verification — their page write "
+            "positions rolled back so the KV arena never serves them",
+        )
         self.session_failovers = Counter(
             "cordum_sched_session_failovers_total",
             "In-flight jobs re-dispatched to a new worker, by reason "
@@ -722,6 +740,9 @@ class Metrics:
             self.serving_hibernate,
             self.serving_hibernate_pause,
             self.serving_resident_sessions,
+            self.serving_spec_drafted,
+            self.serving_spec_accepted,
+            self.serving_spec_rolled_back,
             self.session_failovers,
             self.spans_dropped,
             self.telemetry_snapshots,

@@ -392,7 +392,10 @@ def ragged_step(
     ``page_tables`` each token belongs to (padding tokens → S); out_idx:
     [S] int32 index into the token buffer of each sequence's last fed token
     (the sampling position; unused rows point anywhere).  Returns
-    (next_tokens [S] int32, k_pages, v_pages).
+    (next_tokens [T] int32 — the next-token argmax after every fed buffer
+    position; a sequence's sample is row ``out_idx[s]``, a draft row's
+    per-position verification votes are its contiguous token slots —
+    k_pages, v_pages).
 
     Shape discipline is the whole point: every operand has a static shape
     regardless of how many sequences are live or how long each one is, so
@@ -437,11 +440,15 @@ def ragged_step(
         up = mlp_in @ layer["w_up"]
         x = x + ((gate * up) @ layer["w_down"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    # lm_head only at the sampling positions (each sequence's last fed
-    # token), not all T buffer rows — prefill chunk interiors never pay the
-    # vocab projection
-    xo = x[:, 0][out_idx]  # [S, d]
-    logits = xo @ params["lm_head"]  # [S, V]
+    # lm_head over EVERY buffer row: speculative verification needs the
+    # next-token prediction at each fed draft position, not just the
+    # sequence-final one — a draft row's k+1 per-position argmaxes are the
+    # accept-prefix votes (docs/SERVING.md §Speculative decoding).  The
+    # per-row argmax at ``out_idx`` positions is unchanged math, so
+    # non-draft sampling reads ``preds[out_idx]`` and gets exactly the
+    # tokens the sequence-final projection produced; padding rows project
+    # too but nothing reads them.
+    logits = x[:, 0] @ params["lm_head"]  # [T, V]
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
 
 

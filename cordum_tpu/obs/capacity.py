@@ -371,6 +371,17 @@ class CapacityView:
         w = self._fresh(worker_id)
         return str(w["serving_role"]) if w is not None else ""
 
+    def spec_accept(self, worker_id: str) -> Optional[float]:
+        """The worker's speculative-decoding acceptance EWMA (rides the
+        occupancy block, docs/SERVING.md §Speculative decoding); ``None``
+        = speculation disabled there, or unmeasured/stale.  Presence is
+        the ServingPlacer's draft-enabled signal for speculable traffic."""
+        w = self._fresh(worker_id)
+        if w is None:
+            return None
+        rate = w["occupancy"].get("spec_accept_rate")
+        return float(rate) if rate is not None else None
+
     def draining(self, worker_id: str) -> bool:
         w = self._fresh(worker_id)
         return bool(w["draining"]) if w is not None else False
@@ -411,7 +422,7 @@ _WORKER_COLS = (
     ("worker", "worker"), ("role", "role"), ("kv_free", "kv_free"),
     ("kv_used", "kv_used"), ("sessions", "sessions"), ("occ", "occ"),
     ("pfx_pages", "pfx_pages"), ("pfx_hit", "pfx_hit"),
-    ("resident", "resident"), ("hib", "hib"),
+    ("resident", "resident"), ("hib", "hib"), ("accept", "accept"),
     ("draining", "draining"), ("fresh", "fresh"),
 )
 
@@ -456,6 +467,10 @@ def render_worker_table(workers: dict) -> list[str]:
                         if "prefix_hit_rate" in occ else "-"),
             "resident": resident,
             "hib": str(occ.get("hibernated_sessions", "-")),
+            # speculative acceptance EWMA; "-" = speculation disabled on
+            # that worker (the key never rides its occupancy beacon)
+            "accept": (f"{occ['spec_accept_rate']:.0%}"
+                       if "spec_accept_rate" in occ else "-"),
             "draining": "yes" if w.get("draining") else "no",
             "fresh": "yes" if w.get("fresh", True) else "no",
         })

@@ -967,6 +967,13 @@ SERVING_ROLES = frozenset(
     {SERVING_ROLE_PREFILL, SERVING_ROLE_DECODE, SERVING_ROLE_MIXED}
 )
 LABEL_SERVING_ROLE = "cordum.serving_role"
+# Submitter hint that this session's prompts are templated/repetitive and
+# will benefit from the serving engine's self-speculative decoder.  The
+# ServingPlacer PREFERS draft-enabled workers (those exporting
+# ``spec_accept_rate`` in their occupancy block) when this label is set,
+# but never hard-filters on it — a fleet with speculation disabled
+# everywhere still places normally.
+LABEL_SPECULABLE = "cordum.speculable"
 # Steady-state decode tokens/s this worker measured for itself (the
 # capacity profiler's llm.generate row) — peers rank hand-off targets by
 # KV-page headroom × this rate without a capacity-matrix RPC.

@@ -23,6 +23,26 @@ TERMINAL_JOB_STATES = {"SUCCEEDED", "FAILED", "CANCELLED", "TIMEOUT", "DENIED"}
 TERMINAL_RUN_STATES = {"SUCCEEDED", "FAILED", "CANCELLED"}
 
 
+def merge_stream_packet(
+    n_seen: int, offset: Any, tokens: list
+) -> tuple[list[int], int]:
+    """Offset-dedupe one stream packet against an assembled sequence of
+    ``n_seen`` tokens already yielded: indexes below ``n_seen`` are
+    duplicates (a failed-over worker replays the streamed prefix at offset
+    0), exactly ``n_seen`` extends the stream, and a gap above it is left
+    for the authoritative terminal-result tail.  Packets may carry ANY
+    number of tokens — a speculative-decoding burst lands as one multi-
+    token packet and must merge exactly like k single-token packets.
+    Returns ``(fresh_tokens, new_n_seen)``."""
+    off = offset if isinstance(offset, int) and offset >= 0 else n_seen
+    fresh: list[int] = []
+    for i, t in enumerate(tokens):
+        if off + i == n_seen:
+            n_seen += 1
+            fresh.append(int(t))
+    return fresh, n_seen
+
+
 class ApiError(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(f"HTTP {status}: {message}")
@@ -245,14 +265,10 @@ class Client:
                     # crashes and migrations (docs/SERVING.md).  A gap
                     # (index above n_seen: a lost packet) is left for the
                     # authoritative terminal-result tail below.
-                    toks = pl.get("tokens") or []
-                    off = pl.get("offset")
-                    if not isinstance(off, int) or off < 0:
-                        off = n_seen  # legacy packets: assume contiguous
-                    for i, t in enumerate(toks):
-                        if off + i == n_seen:
-                            n_seen += 1
-                            yield int(t)
+                    fresh, n_seen = merge_stream_packet(
+                        n_seen, pl.get("offset"), pl.get("tokens") or [])
+                    for t in fresh:
+                        yield t
                 elif pkt.get("kind") == "job_result":
                     if pl.get("status") != "SUCCEEDED":
                         raise ApiError(

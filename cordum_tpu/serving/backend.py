@@ -46,7 +46,15 @@ class StepEntry:
     token) at its current position; a prefill chunk feeds a slice of the
     prompt starting at ``start``.  ``sample=True`` asks for the next token
     from the last fed position (always for decode; only for the chunk that
-    completes a prompt)."""
+    completes a prompt).
+
+    ``draft > 0`` marks a speculative verification row (docs/SERVING.md
+    §Speculative decoding): ``tokens`` is ``[last_token, d_1..d_k]`` — the
+    session's last emitted token followed by ``draft`` drafted
+    continuations — and :meth:`LlamaServingBackend.step` returns the
+    per-position next-token predictions for ALL k+1 fed positions (a
+    ``list[int]``) instead of the single sequence-final sample.  The row
+    is prefill-shaped on the wire; only the result shape differs."""
 
     tokens: list[int]
     start: int  # global sequence position of tokens[0]
@@ -54,9 +62,16 @@ class StepEntry:
     sample: bool = True
     phase: str = "decode"  # "prefill" | "decode" — observability + fakes
     key: str = ""  # session/job id — observability + fakes
+    draft: int = 0  # >0: speculative row with this many drafted tokens
 
 
 class LlamaServingBackend:
+    # the ragged program returns per-position predictions for every buffer
+    # row, so draft verification rows (StepEntry.draft > 0) are supported
+    # natively — the engine gates its drafter on this capability flag
+    # (test fakes without it keep the legacy single-sample step contract)
+    supports_draft = True
+
     def __init__(
         self,
         cfg: Any = None,
@@ -136,12 +151,15 @@ class LlamaServingBackend:
         return [min(max(0, int(t)), vmax) for t in row]
 
     # ------------------------------------------------------------------
-    def step(self, entries: list[StepEntry]) -> list[Optional[int]]:
+    def step(self, entries: list[StepEntry]) -> list[Any]:
         """One ragged mixed prefill+decode device call.
 
-        Returns one value per entry, aligned: the next token for sampled
-        entries, ``None`` for prefill chunks that do not complete their
-        prompt.  Blocking; call from an executor thread."""
+        Returns one value per entry, aligned: the next token (``int``) for
+        sampled entries, ``None`` for prefill chunks that do not complete
+        their prompt, and the per-position prediction list (``list[int]``,
+        one next-token argmax per fed position) for draft verification
+        rows (``entry.draft > 0``).  Blocking; call from an executor
+        thread."""
         import jax.numpy as jnp
 
         self._ensure()
@@ -167,6 +185,7 @@ class LlamaServingBackend:
         tables = np.zeros((s_rows + 1, self.pages_per_seq), np.int32)
         out_idx = np.zeros((s_rows,), np.int32)
         ti = 0
+        spans: list[tuple[int, int]] = []  # entry i's [lo, hi) buffer slots
         for i, e in enumerate(entries):
             row = self._clamp(e.tokens)
             n = len(row)
@@ -182,6 +201,7 @@ class LlamaServingBackend:
             token_seq[ti:ti + n] = i
             tables[i, : len(e.pages)] = e.pages
             out_idx[i] = ti + n - 1
+            spans.append((ti, ti + n))
             ti += n
         shape_key = ("ragged", t_buf, s_rows, self.pages_per_seq)
         self.last_step_compiled = shape_key not in self._compiled_shapes
@@ -197,8 +217,19 @@ class LlamaServingBackend:
                 jnp.asarray(out_idx),
             )
             out = np.asarray(nxt)
-        return [int(out[i]) if e.sample else None
-                for i, e in enumerate(entries)]
+        # out is [T] per-position predictions: a sampled entry's token is
+        # the prediction after its LAST fed slot (== out_idx[i], the same
+        # value the old sequence-final projection produced); a draft row
+        # gets the whole span — one verification vote per fed position
+        res: list[Any] = []
+        for e, (lo, hi) in zip(entries, spans):
+            if e.draft > 0:
+                res.append([int(t) for t in out[lo:hi]])
+            elif e.sample:
+                res.append(int(out[hi - 1]))
+            else:
+                res.append(None)
+        return res
 
     # ------------------------------------------------------------------
     # live KV-page migration (serving/migration.py, docs/PROTOCOL.md §Page

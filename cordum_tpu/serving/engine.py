@@ -53,6 +53,13 @@ DEFAULT_MAX_CONCURRENT_PREFILLS = 2
 # SLO classes whose prefill chunks take the step budget first
 # (docs/ADMISSION.md §Serving)
 INTERACTIVE_CLASSES = frozenset({"INTERACTIVE", "CRITICAL"})
+# speculative decoding (docs/SERVING.md §Speculative decoding): default
+# draft length cap, the per-session acceptance EWMA that throttles the
+# next step's draft length, and the engine-level EWMA the capacity block
+# publishes as spec_accept_rate
+DEFAULT_DRAFT_K = 4
+SPEC_EWMA_ALPHA = 0.4
+SPEC_FLEET_ALPHA = 0.2
 
 
 class SessionCancelled(Exception):
@@ -120,6 +127,10 @@ class ServingStats:
     prefix_misses: int = 0
     prefix_hit_tokens: int = 0  # prompt tokens whose prefill was skipped
     cow_copies: int = 0  # copy-on-write page duplications
+    drafted_tokens: int = 0  # speculative tokens proposed into draft rows
+    accepted_tokens: int = 0  # drafts verified and kept (bonus excluded)
+    rolled_back_tokens: int = 0  # drafts rejected; write positions rolled back
+    spec_steps: int = 0  # steps that carried at least one draft row
     hibernated_out: int = 0  # live sessions tiered whole to the cold arena
     restored_in: int = 0  # live sessions restored from the cold arena
     occupancy_sum: int = 0
@@ -160,6 +171,11 @@ class _Session:
     # governor immunity: a migrated-in session may not be rebalanced again
     # before this monotonic stamp (the anti-ping-pong cooldown)
     immune_until: float = 0.0
+    # speculative decoding: the session's acceptance EWMA (throttles the
+    # next step's draft length; optimistic start so drafts flow at once)
+    # and the tokens the drafter planned for the upcoming step
+    accept_ewma: float = 1.0
+    draft_plan: list[int] = field(default_factory=list)
     enqueued_at: float = field(default_factory=time.monotonic)
 
     @property
@@ -204,6 +220,9 @@ class ServingEngine:
         migrate_in_cooldown_s: float = 30.0,
         prefix_cache: bool = True,
         hibernate_after_s: float = 0.0,
+        speculative: bool = False,
+        draft_k: int = DEFAULT_DRAFT_K,
+        drafter: Optional[Callable[[list[int], int], list[int]]] = None,
     ) -> None:
         self.backend = backend
         self.run_blocking = run_blocking  # worker.run_in_executor
@@ -267,6 +286,21 @@ class ServingEngine:
             )
             if self.prefix is not None else None
         )
+        # speculative decoding (docs/SERVING.md §Speculative decoding):
+        # the self-speculative drafter proposes k tokens per decoding
+        # session per step; verification rides the same ragged program as
+        # prefill-shaped draft rows with per-position sampling.  Gated on
+        # the backend's per-position prediction support — fakes and legacy
+        # backends without ``supports_draft`` keep the exact legacy step
+        # shape (byte-for-byte: no draft rows are ever assembled).
+        self.speculative = bool(speculative) and bool(
+            getattr(backend, "supports_draft", False)
+        )
+        self.draft_k = max(1, int(draft_k or DEFAULT_DRAFT_K))
+        self._drafter = drafter or self._ngram_draft
+        # engine-level acceptance EWMA — the capacity block publishes it
+        # as spec_accept_rate so the placer can route speculable traffic
+        self.spec_accept_ewma = 0.0
         self._tiering_task: Optional[asyncio.Task] = None
         self.stats = ServingStats()
         self._pending: deque[_Session] = deque()
@@ -680,6 +714,60 @@ class ServingEngine:
                 sess.future.set_exception(error)
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _ngram_draft(history: list[int], k: int) -> list[int]:
+        """Prompt-lookup drafting — the zero-extra-weights self-speculative
+        drafter: find the most recent earlier occurrence of the history's
+        final n-gram and propose the tokens that followed it.  Longest gram
+        first (a longer match is stronger evidence the continuation
+        repeats), most-recent-first within a gram so loops and templates
+        match their latest iteration.  Returns ``[]`` when nothing matches:
+        the session decodes a plain single-token row this step."""
+        n = len(history)
+        for g in (3, 2, 1):
+            if n <= g:
+                continue
+            tail = history[-g:]
+            # bounded lookback keeps a very long conversation O(window)
+            lo = max(0, n - g - 512)
+            for i in range(n - g - 1, lo - 1, -1):
+                if history[i:i + g] == tail:
+                    cont = history[i + g:i + g + k]
+                    if cont:
+                        return cont
+        return []
+
+    def _plan_drafts(self) -> None:
+        """Propose draft continuations for every decoding session — BEFORE
+        CoW resolution (the write span must cover the planned draft
+        positions) and before assembly (which trims plans to the step's
+        flat-buffer budget).  The per-session acceptance EWMA throttles the
+        proposal length: a session whose drafts keep verifying ramps to
+        ``draft_k``, one whose drafts keep rejecting decays to single-token
+        probes.  The length clamp ``k <= remaining - 1`` guarantees a fully
+        accepted burst (k drafts + the bonus token) never overshoots
+        ``max_new_tokens`` — and therefore never writes outside the
+        session's admitted page footprint."""
+        if not self.speculative:
+            return
+        for sess in self._active.values():
+            sess.draft_plan = []
+            if not sess.prefilled or sess.frozen or sess.cancelled:
+                continue
+            room = sess.req.max_new_tokens - len(sess.out_tokens)
+            k_cap = min(self.draft_k, room - 1)
+            if k_cap < 1:
+                continue
+            k = 1 + int(round(sess.accept_ewma * (k_cap - 1)))
+            history = sess.req.prompt + sess.out_tokens
+            try:
+                plan = self._drafter(history, k)
+            except Exception as e:  # noqa: BLE001 - drafting is best-effort
+                logx.warn("drafter failed", job_id=sess.job_id, err=str(e))
+                plan = []
+            sess.draft_plan = [int(t) for t in plan[:k]]
+
+    # ------------------------------------------------------------------
     async def _resolve_cow(self) -> frozenset[str]:
         """Copy-on-write guard (docs/SERVING.md §Prefix cache and
         tiering): before assembling a step, any page a session is about
@@ -697,7 +785,11 @@ class ServingEngine:
             if sess.frozen or sess.cancelled or sess.job_id not in self._active:
                 continue
             if sess.prefilled:
-                write_pages = range(sess.pos // ps, sess.pos // ps + 1)
+                # a draft row writes positions [pos, pos + k]: the span may
+                # cross into the next page (or start inside a shared prefix
+                # page), so every page it touches gets the CoW guard
+                hi = sess.pos + len(sess.draft_plan)
+                write_pages = range(sess.pos // ps, hi // ps + 1)
             else:
                 lo = sess.prefill_pos // ps
                 hi = min(
@@ -753,27 +845,47 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def _assemble(
         self, skip: frozenset = frozenset()
-    ) -> tuple[list[StepEntry], list[tuple[_Session, int, bool]]]:
-        """Build one mixed step: a decode row for every prefilled session,
+    ) -> tuple[list[StepEntry], list[tuple[_Session, int, bool, list[int]]]]:
+        """Build one mixed step: a decode row for every prefilled session
+        (with its planned draft tokens appended while the budget lasts),
         then prompt chunks for prefilling ones (admission order) within the
         flat token budget and the per-step chunk cap.  Returns the entries
-        plus aligned ``(session, chunk_len, samples)`` bookkeeping.
-        ``skip`` rows sit this step out (CoW starved for a fresh page)."""
+        plus aligned ``(session, chunk_len, samples, draft_tokens)``
+        bookkeeping.  ``skip`` rows sit this step out (CoW starved for a
+        fresh page)."""
         entries: list[StepEntry] = []
-        rows: list[tuple[_Session, int, bool]] = []
+        rows: list[tuple[_Session, int, bool, list[int]]] = []
         budget = self.step_tokens
         chunks = 0
-        for sess in self._active.values():
+        decoding = [
             # frozen = mid-migration freeze-and-delta: the session's pages
             # are being shipped; its rows sit this step (and the next) out
-            if not sess.prefilled or sess.frozen or sess.job_id in skip:
-                continue
+            s for s in self._active.values()
+            if s.prefilled and not s.frozen and s.job_id not in skip
+        ]
+        # draft budget: the flat-buffer slots left after every decode row's
+        # base token.  While prompts are waiting to prefill, drafts take at
+        # most half the leftover so speculation can never starve admission
+        # latency — the prefill chunks below ride the rest.
+        waiting = any(
+            not s.prefilled and not s.frozen and s.job_id not in skip
+            for s in self._active.values()
+        )
+        spare = budget - len(decoding)
+        draft_budget = (
+            (spare // 2 if waiting else spare) if self.speculative else 0
+        )
+        for sess in decoding:
+            plan = sess.draft_plan[:draft_budget] if draft_budget > 0 else []
+            sess.draft_plan = []
             entries.append(StepEntry(
-                tokens=[sess.last_token], start=sess.pos, pages=sess.pages,
-                sample=True, phase="decode", key=sess.job_id,
+                tokens=[sess.last_token, *plan], start=sess.pos,
+                pages=sess.pages, sample=True, phase="decode",
+                key=sess.job_id, draft=len(plan),
             ))
-            rows.append((sess, 1, True))
-            budget -= 1
+            rows.append((sess, 1 + len(plan), True, plan))
+            budget -= 1 + len(plan)
+            draft_budget -= len(plan)
         # prefill candidates ride interactive-first (stable within a class,
         # so admission order still breaks ties): under load the leftover
         # token budget goes to interactive prompts and BATCH prefill waits —
@@ -805,7 +917,7 @@ class ServingEngine:
                 sample=samples, phase="prefill",
                 key=sess.job_id,
             ))
-            rows.append((sess, chunk, samples))
+            rows.append((sess, chunk, samples, []))
             budget -= chunk
             chunks += 1
         return entries, rows
@@ -832,6 +944,7 @@ class ServingEngine:
                 else:
                     await asyncio.sleep(0.001)  # pages freeing: poll soon
                 continue
+            self._plan_drafts()
             entries, rows = self._assemble(await self._resolve_cow())
             if not entries:  # defensive: all rows parked past the budget
                 await asyncio.sleep(0.001)
@@ -845,7 +958,7 @@ class ServingEngine:
                     parent_span_id=oldest.parent_span_id,
                     attrs={"occupancy": str(len(rows))},
                 )
-            self._in_step = frozenset(s.job_id for s, _, _ in rows)
+            self._in_step = frozenset(s.job_id for s, _, _, _ in rows)
             try:
                 results = await self.run_blocking(self.backend.step, entries)
             except Exception as e:  # noqa: BLE001 - whole-step failure
@@ -857,7 +970,7 @@ class ServingEngine:
                 if step_span is not None and self.tracer is not None:
                     step_span.attrs["error"] = type(e).__name__
                     await self.tracer.finish(step_span, status="ERROR")
-                for sess, _, _ in rows:
+                for sess, _, _, _ in rows:
                     self.stats.failed += 1
                     self._retire(sess, error=e)
                 continue
@@ -865,34 +978,93 @@ class ServingEngine:
             generated = 0
             prefill_fed = 0
             retired_this_step = 0
+            step_drafted = 0
+            step_accepted = 0
             emits = []
-            for (sess, chunk, samples), tok in zip(rows, results):
-                if sess.prefilled:
-                    sess.pos += 1  # decode row: wrote its token at pos
-                else:
-                    sess.prefill_pos += chunk
-                    sess.pos = sess.prefill_pos
-                    prefill_fed += chunk
-                    self.stats.prefill_chunks += 1
-                if samples and tok is not None:
-                    t = int(tok)
-                    sess.last_token = t
-                    sess.out_tokens.append(t)
-                    generated += 1
-                    if len(sess.out_tokens) == 1:
-                        # first token of a locally born session: TTFT
-                        # (resume prefixes pre-populate out_tokens, so
-                        # migrated/resumed sessions never land here)
+            retires = []
+            for (sess, chunk, samples, drafted), tok in zip(rows, results):
+                if drafted:
+                    # speculative verification row: the backend returned
+                    # one next-token prediction per fed position.  Accept
+                    # the longest draft prefix the model agrees with, then
+                    # the bonus token — the prediction after the last
+                    # accepted draft, which is exactly what a sequential
+                    # decode would have sampled next (so the burst is
+                    # token-identical to the oracle by construction).
+                    preds = [int(t) for t in tok]
+                    a = 0
+                    while a < len(drafted) and drafted[a] == preds[a]:
+                        a += 1
+                    burst = drafted[:a] + [preds[a]]
+                    eos = sess.req.eos_token
+                    if eos is not None and eos in burst:
+                        burst = burst[:burst.index(eos) + 1]
+                    rejected = len(drafted) - a
+                    step_drafted += len(drafted)
+                    step_accepted += a
+                    frac = a / len(drafted)
+                    sess.accept_ewma += SPEC_EWMA_ALPHA * (
+                        frac - sess.accept_ewma
+                    )
+                    self.spec_accept_ewma += SPEC_FLEET_ALPHA * (
+                        frac - self.spec_accept_ewma
+                    )
+                    self.stats.drafted_tokens += len(drafted)
+                    self.stats.accepted_tokens += a
+                    self.stats.rolled_back_tokens += rejected
+                    if self.metrics is not None:
+                        self.metrics.serving_spec_drafted.inc(
+                            float(len(drafted)))
+                        self.metrics.serving_spec_accepted.inc(float(a))
+                        if rejected:
+                            self.metrics.serving_spec_rolled_back.inc(
+                                float(rejected))
+                    # page write-position rollback: pos advances over the
+                    # verified burst ONLY.  Rejected draft positions sit at
+                    # >= the new pos; every later step writes its own K/V
+                    # there before any gather runs (writes precede gathers
+                    # inside the ragged program, and positions are consumed
+                    # contiguously), so the arena never serves speculated
+                    # garbage.
+                    first = not sess.out_tokens
+                    sess.pos += len(burst)
+                    sess.last_token = burst[-1]
+                    sess.out_tokens.extend(burst)
+                    generated += len(burst)
+                    if first:
                         self.stats.ttft_seconds.append(
                             time.monotonic() - sess.enqueued_at
                         )
-                    emits.append(self._emit(sess, [t]))
+                    emits.append(self._emit(sess, burst))
+                else:
+                    if sess.prefilled:
+                        sess.pos += 1  # decode row: wrote its token at pos
+                    else:
+                        sess.prefill_pos += chunk
+                        sess.pos = sess.prefill_pos
+                        prefill_fed += chunk
+                        self.stats.prefill_chunks += 1
+                    if samples and tok is not None:
+                        t = int(tok)
+                        sess.last_token = t
+                        sess.out_tokens.append(t)
+                        generated += 1
+                        if len(sess.out_tokens) == 1:
+                            # first token of a locally born session: TTFT
+                            # (resume prefixes pre-populate out_tokens, so
+                            # migrated/resumed sessions never land here)
+                            self.stats.ttft_seconds.append(
+                                time.monotonic() - sess.enqueued_at
+                            )
+                        emits.append(self._emit(sess, [t]))
                 if sess.done or sess.cancelled:
                     retired_this_step += 1
-                    self._retire(
-                        sess,
-                        error=SessionCancelled(sess.job_id) if sess.cancelled else None,
-                    )
+                    # deferred below the emit gather: the future must not
+                    # resolve before the session's final token packet is
+                    # delivered, or a submitter that stops the engine the
+                    # moment submit() returns races the stream's tail (the
+                    # exactly-once contract spec bursts lean on)
+                    retires.append(sess)
                 elif (
                     self.on_prefill_done is not None
                     and not sess.handoff_signaled
@@ -915,6 +1087,8 @@ class ServingEngine:
             self.stats.steps += 1
             self.stats.decoded_tokens += generated
             self.stats.prefill_tokens += prefill_fed
+            if step_drafted:
+                self.stats.spec_steps += 1
             self.stats.occupancy_sum += len(rows)
             self.stats.max_occupancy = max(self.stats.max_occupancy, len(rows))
             self.stats.step_seconds.append(dt)
@@ -952,6 +1126,12 @@ class ServingEngine:
                     )
             if emits:
                 await asyncio.gather(*emits)
+            for sess in retires:
+                self._retire(
+                    sess,
+                    error=SessionCancelled(sess.job_id)
+                    if sess.cancelled else None,
+                )
             # every token of this step is appended AND emitted: a freeze
             # waiting on wait_quiesced() now sees a fully consistent session
             self._in_step = frozenset()
@@ -962,6 +1142,9 @@ class ServingEngine:
                 step_span.attrs["retired"] = str(retired_this_step)
                 step_span.attrs["prefill_tokens"] = str(prefill_fed)
                 step_span.attrs["step_ms"] = f"{dt * 1000:.2f}"
+                if self.speculative:
+                    step_span.attrs["drafted"] = str(step_drafted)
+                    step_span.attrs["accepted"] = str(step_accepted)
                 await self.tracer.finish(step_span)
             self._gauge()
             # yield to the loop so intake/cancel/heartbeat tasks run between

@@ -410,6 +410,8 @@ def make_serving_engine(
     handoff_tokens: int = 0,
     prefix_cache: bool = True,
     hibernate_after_s: float = 0.0,
+    speculative: bool = True,
+    draft_k: int = 0,
     metrics=None,
 ):
     """Build the worker's continuous-batching serving engine over a paged
@@ -446,6 +448,9 @@ def make_serving_engine(
         handoff_threshold_tokens=handoff_tokens,
         prefix_cache=prefix_cache,
         hibernate_after_s=hibernate_after_s,
+        speculative=speculative,
+        # draft_k == 0 means "engine default" so config files can omit it
+        **({"draft_k": draft_k} if draft_k > 0 else {}),
         metrics=metrics,
         tracer=worker.tracer,
         capacity=worker.capacity,
@@ -468,6 +473,8 @@ def attach_default_tpu_worker(
     serving_handoff_tokens: int = 0,
     serving_prefix_cache: bool = True,
     serving_hibernate_after_s: float = 0.0,
+    serving_speculative: bool = True,
+    serving_draft_k: int = 0,
     gang: bool = True,
     gang_rendezvous_timeout_s: float = 10.0,
     gang_peer_timeout_s: float = 30.0,
@@ -495,6 +502,8 @@ def attach_default_tpu_worker(
             handoff_tokens=serving_handoff_tokens,
             prefix_cache=serving_prefix_cache,
             hibernate_after_s=serving_hibernate_after_s,
+            speculative=serving_speculative,
+            draft_k=serving_draft_k,
             metrics=metrics,
         ))
     if gang:

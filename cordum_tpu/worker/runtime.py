@@ -287,6 +287,13 @@ class Worker:
                 doc["resident_warm"] = warm
                 doc["resident_cold"] = cold
                 doc["hibernated_sessions"] = len(serving.tiering.arena)
+            if serving.speculative:
+                # speculative acceptance (docs/SERVING.md §Speculative
+                # decoding): the engine-level EWMA rides the existing
+                # occupancy block, so the capacity matrix and the placer's
+                # speculable-hint preference need no new ingest schema —
+                # absence of the key IS the "speculation disabled" signal
+                doc["spec_accept_rate"] = round(serving.spec_accept_ewma, 3)
             return doc
 
         self.capacity.set_occupancy(_occupancy)

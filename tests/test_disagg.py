@@ -28,6 +28,7 @@ from cordum_tpu.protocol.types import (
     Heartbeat,
     JobCancel,
     JobRequest,
+    LABEL_BATCH_KEY,
     LABEL_MIGRATE_ADDR,
     LABEL_OP,
     LABEL_SESSION_KEY,
@@ -213,6 +214,36 @@ def test_retarget_session_follows_ownership():
         job_id="j2", topic="job.tpu.generate",
         labels={LABEL_OP: "llm.generate", LABEL_SESSION_KEY: "conv-9"}))
     assert nxt == "worker.w-dec.jobs"
+
+
+def test_batch_sticky_win_still_elects_session_affinity():
+    """A session-carrying job routed by its batch key (a workflow turn
+    riding wf-tpl template co-location, docs/SERVING.md §Prefix cache and
+    tiering) must still record its session entry: the batch-sticky early
+    return used to skip the election, so every later turn of the run
+    counted "new" and could never hit."""
+    view = StubView()
+    strat, reg = _mk_strategy(view)
+    reg.update(hb("w-a"))
+    reg.update(hb("w-b"))
+    # establish the template's batch entry (turn 1 of some sibling run)
+    first = strat.pick_subject(JobRequest(
+        job_id="r1:plan@1", topic="job.tpu.generate",
+        labels={LABEL_OP: "llm.generate", LABEL_BATCH_KEY: "wf-tpl:agent"}))
+    # a session whose affinity entry is absent rides the batch key ...
+    second = strat.pick_subject(JobRequest(
+        job_id="r2:plan@1", topic="job.tpu.generate",
+        labels={LABEL_OP: "llm.generate", LABEL_BATCH_KEY: "wf-tpl:agent",
+                LABEL_SESSION_KEY: "run-7"}))
+    assert second == first
+    # ... and that ride must have elected the session entry: the follow-up
+    # turn (no batch key — e.g. a direct cancel/turn on the session) hits
+    third = strat.pick_subject(JobRequest(
+        job_id="r2:act@1", topic="job.tpu.generate",
+        labels={LABEL_OP: "llm.generate", LABEL_SESSION_KEY: "run-7"}))
+    assert third == first
+    assert strat.session_affinity_hits == 1, (
+        strat.session_affinity_hits, strat.session_affinity_new)
 
 
 # ---------------------------------------------------------------------------

@@ -322,6 +322,11 @@ _HEALTHY_CHAT = {
     "chat_restored_pages": 8, "chat_restore_pause_p50_ms": 1.0,
 }
 
+_HEALTHY_SPEC = {
+    "spec_decode_speedup": 1.96, "spec_token_identity": 1,
+    "spec_compile_count": 1,
+}
+
 
 def test_floor_checker_passes_healthy_doc():
     mod = _floor_mod()
@@ -336,7 +341,7 @@ def test_floor_checker_passes_healthy_doc():
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
            **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG,
-           **_HEALTHY_AGENTS, **_HEALTHY_CHAT}
+           **_HEALTHY_AGENTS, **_HEALTHY_CHAT, **_HEALTHY_SPEC}
     floors = json.loads((REPO / "bench_floor.json").read_text())
     assert mod.check(doc, floors) == []
 
@@ -357,7 +362,7 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
            **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG,
-           **_HEALTHY_AGENTS, **_HEALTHY_CHAT}
+           **_HEALTHY_AGENTS, **_HEALTHY_CHAT, **_HEALTHY_SPEC}
     violations = mod.check(doc, floors)
     assert violations and "value" in violations[0]
     # ceilings guard the other direction (round-trip budget regression)

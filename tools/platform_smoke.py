@@ -182,7 +182,14 @@ def wait_job(c: httpx.Client, job_id: str, want: str, timeout_s: float = 60.0) -
     t0 = time.time()
     doc = {}
     while time.time() - t0 < timeout_s:
-        doc = c.get(f"/api/v1/jobs/{job_id}?result=true").json()
+        # a transient gateway stall (1-core host: migration/compile churn
+        # starves the event loop) must not kill the whole smoke — the
+        # deadline above still bounds the wait
+        try:
+            doc = c.get(f"/api/v1/jobs/{job_id}?result=true").json()
+        except httpx.TransportError:
+            time.sleep(1.0)
+            continue
         if doc.get("state") == want:
             return doc
         if doc.get("state") in ("FAILED", "DENIED", "TIMEOUT", "CANCELLED") and doc.get("state") != want:
@@ -195,7 +202,11 @@ def wait_run(c: httpx.Client, run_id: str, want: str, timeout_s: float = 90.0) -
     t0 = time.time()
     doc = {}
     while time.time() - t0 < timeout_s:
-        doc = c.get(f"/api/v1/runs/{run_id}").json()
+        try:
+            doc = c.get(f"/api/v1/runs/{run_id}").json()
+        except httpx.TransportError:  # transient gateway stall; see wait_job
+            time.sleep(1.0)
+            continue
         if doc.get("status") == want:
             return doc
         if doc.get("status") in ("FAILED", "CANCELLED") and doc.get("status") != want:
@@ -584,6 +595,12 @@ def main() -> int:
                     "CORDUM_SCHEDULER_SHARDS": "2",
                     "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
                     "CORDUM_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                    # hermetic like the boot-time workers: without this the
+                    # only post-drain worker senses the harness's own CPU
+                    # burn, reads overloaded (cpu_load>=90), and every
+                    # affinity election silently fails onto topic fan-in —
+                    # step 12's session-affinity hits become impossible
+                    "CORDUM_HOST_LOAD": "0",
                     "WORKER_ID": "smoke-w2", "WORKER_POOL": "tpu",
                     "WORKER_TOPICS": "job.tpu.>,job.default,job.hello-pack.echo",
                     "WORKER_CAPABILITIES": "tpu,echo",
@@ -732,14 +749,23 @@ def main() -> int:
                 assert owners == {"smoke-w2"}, f"session hopped workers: {turn_workers}"
                 # and the affinity cache produced real hits (6 session jobs
                 # over <=2 shards: some shard routed a repeat)
-                hits_after = hits_before
+                hits_after, aff_lines = hits_before, []
                 t0 = time.time()
                 while time.time() - t0 < 30 and hits_after <= hits_before:
-                    hits_after = _affinity_hits(
-                        httpx.get(f"{API}/metrics?scope=fleet", timeout=10.0).text)
+                    fleet_text = httpx.get(f"{API}/metrics?scope=fleet",
+                                           timeout=10.0).text
+                    hits_after = _affinity_hits(fleet_text)
+                    aff_lines = [
+                        ln for ln in fleet_text.splitlines()
+                        if ln.startswith("cordum_session_affinity_total")]
                     if hits_after <= hits_before:
                         time.sleep(1.0)
-                assert hits_after > hits_before, (hits_before, hits_after)
+                # failure triage: no lines at all = the serving placement
+                # path never engaged (scheduler's capacity view had no
+                # fresh prefill rate — beacon starvation under load);
+                # new/miss lines without hit = no shard saw a repeat
+                assert hits_after > hits_before, (
+                    hits_before, hits_after, aff_lines)
             # one trace per run: the run root span plus >=3 distinct DAG
             # stages parented under it
             trace_id = last_run.get("trace_id", "")
@@ -899,6 +925,140 @@ def main() -> int:
                     f"({len(turn2['result']['tokens'])} tokens exactly once)")
             else:
                 log("13. prefix+tiering: skipped (external deployment)")
+
+            # 14. speculative decoding (docs/SERVING.md §Speculative
+            # decoding): a templated (motif-heavy) llm.generate session on
+            # the live stack engages the prompt-lookup drafter — non-zero
+            # drafts verified and ACCEPTED through the ragged step — while
+            # a control worker started with WORKER_SERVING_SPECULATIVE=0
+            # generates the identical token sequence for the same prompt
+            # (speculation is a schedule change, not a math change).  The
+            # accept EWMA rides only the spec worker's occupancy beacon,
+            # and no worker ever compiled a second ragged program: draft
+            # verification rows are prefill-shaped, so they reuse the one
+            # static-shape serving executable.
+            if not external:
+                def _spec_fleet() -> str:
+                    return httpx.get(f"{API}/metrics?scope=fleet",
+                                     timeout=10.0).text
+
+                def _spec_ctr(text: str, name: str) -> float:
+                    return sum(
+                        float(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+                        if ln.startswith(name))
+
+                before = _spec_fleet()
+                drafted0 = _spec_ctr(before,
+                                     "cordum_serving_spec_drafted_total")
+                acc0 = _spec_ctr(before,
+                                 "cordum_serving_spec_accepted_total")
+
+                def _spec_ragged(text: str) -> float:
+                    return sum(
+                        float(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+                        if ln.startswith("cordum_serving_compile_total{")
+                        and 'entry="ragged"' in ln)
+
+                ragged0 = _spec_ragged(before)
+                # the spec-disabled control worker: same model, same pool,
+                # speculation forced off
+                w3_env = dict(os.environ)
+                w3_env.update({
+                    "CORDUM_STATEBUS_URL": (
+                        f"statebus://127.0.0.1:{STATEBUS_PORT},"
+                        f"statebus://127.0.0.1:{STATEBUS_PORT + 1}"),
+                    "CORDUM_SCHEDULER_SHARDS": "2",
+                    "PYTHONPATH": REPO + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                    "CORDUM_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                    "CORDUM_HOST_LOAD": "0",
+                    "WORKER_ID": "smoke-w3", "WORKER_POOL": "tpu",
+                    "WORKER_TOPICS": "job.tpu.>,job.default",
+                    "WORKER_CAPABILITIES": "tpu",
+                    "WORKER_HEARTBEAT_INTERVAL": "1",
+                    "WORKER_SERVING_SPECULATIVE": "0",
+                })
+                w3_log = open(os.path.join(logdir, "worker3.log"), "ab")
+                w3 = subprocess.Popen(
+                    [sys.executable, "-m", "cordum_tpu.cmd.worker"],
+                    env=w3_env, stdout=w3_log, stderr=w3_log, cwd=REPO)
+                procs.append(w3)
+                t0 = time.time()
+                while time.time() - t0 < 60:
+                    if "smoke-w3" in c.get("/api/v1/workers").json().get(
+                            "workers", {}):
+                        break
+                    time.sleep(0.5)
+                assert "smoke-w3" in c.get("/api/v1/workers").json()["workers"]
+                # templated prompt: a repeated motif the n-gram drafter can
+                # look up (agent-loop prompts share this shape)
+                motif = [5, 9, 14, 23, 7, 11, 3, 19]
+                tpl = motif * 4 + [2]
+
+                def _spec_gen(sid: str, wid: str) -> dict:
+                    r = c.post("/api/v1/jobs", json={
+                        "topic": "job.tpu.generate",
+                        "payload": {"op": "llm.generate",
+                                    "tokens": list(tpl),
+                                    "max_new_tokens": 48,
+                                    "session_id": sid},
+                        "labels": {"preferred_worker_id": wid}})
+                    assert r.status_code == 202, r.text
+                    return wait_job(c, r.json()["job_id"], "SUCCEEDED", 90)
+
+                spec_doc = _spec_gen("spec-conv", "smoke-w2")
+                ctrl_doc = _spec_gen("spec-ctrl-conv", "smoke-w3")
+                assert spec_doc.get("worker_id") == "smoke-w2", spec_doc
+                assert ctrl_doc.get("worker_id") == "smoke-w3", ctrl_doc
+                assert len(spec_doc["result"]["tokens"]) == 48, spec_doc
+                assert spec_doc["result"]["tokens"] == \
+                    ctrl_doc["result"]["tokens"], (
+                        "speculation changed the generated tokens")
+                # the spec worker verified and accepted real drafts
+                after, t0 = _spec_fleet(), time.time()
+                while time.time() - t0 < 30 and (
+                        _spec_ctr(after, "cordum_serving_spec_accepted_total")
+                        <= acc0):
+                    time.sleep(1.0)
+                    after = _spec_fleet()
+                drafted = _spec_ctr(
+                    after, "cordum_serving_spec_drafted_total") - drafted0
+                accepted = _spec_ctr(
+                    after, "cordum_serving_spec_accepted_total") - acc0
+                assert drafted > 0, "no tokens were ever drafted"
+                assert accepted > 0, "no drafted token was ever accepted"
+                # the acceptance EWMA beacons from the spec worker only;
+                # the control worker's occupancy never carries the key
+                occ2, occ3, t0 = {}, {}, time.time()
+                while time.time() - t0 < 30:
+                    cap_workers = c.get("/api/v1/capacity").json().get(
+                        "workers", {})
+                    occ2 = (cap_workers.get("smoke-w2") or {}).get(
+                        "occupancy") or {}
+                    occ3 = (cap_workers.get("smoke-w3") or {}).get(
+                        "occupancy") or {}
+                    if "spec_accept_rate" in occ2 and occ3:
+                        break
+                    time.sleep(1.0)
+                assert "spec_accept_rate" in occ2, occ2
+                assert "spec_accept_rate" not in occ3, occ3
+                # draft rows never grew the compile ladder: the fleet
+                # counter sums one warmup compile per worker, so the spec
+                # session on the already-warm smoke-w2 must add ZERO and
+                # the fresh control worker exactly its one warmup
+                ragged_added = _spec_ragged(after) - ragged0
+                assert ragged_added == 1.0, (
+                    f"draft rows recompiled the serving program: "
+                    f"{ragged_added} new ragged compiles (expected only "
+                    "the control worker's warmup)")
+                log(f"14. speculative decoding: templated session accepted "
+                    f"{accepted:.0f} of {drafted:.0f} drafted tokens on "
+                    f"smoke-w2, tokens identical to the spec-disabled "
+                    f"control (smoke-w3), accept EWMA beacons from the spec "
+                    f"worker only, zero new ragged compiles on the warm "
+                    f"worker")
+            else:
+                log("14. speculative decoding: skipped (external deployment)")
 
         log("PASS")
         return 0
