@@ -1179,6 +1179,130 @@ def bench_gang(*, smoke: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# sharded serving gangs (bench.py --tp): the SAME session set served by a
+# TP=2 in-process serving gang (docs/SERVING.md §Sharded serving) vs a
+# single-rank worker, same model, same process tree.  The contract metrics
+# are exact: tp_token_identity (TP is a placement change, not a math
+# change — the gang's streams must equal the single-rank fp32 run token
+# for token), tp_compile_per_rank (every rank compiles exactly ONE ragged
+# program), and tp_speedup as the same-run wall ratio.  On a 1-2 core CI
+# host both gang ranks time-share the only core, so the observed ratio
+# sits near 0.5 — the bench_floor.json floor is a COLLAPSE guard (a gang
+# that serializes rank steps or recompiles per rank lands far below it);
+# the real >=1.5x bar needs one chip per rank (see ROADMAP).
+# ---------------------------------------------------------------------------
+
+_TP_KEYS = (
+    "tp_tokens_per_sec", "tp_single_tokens_per_sec", "tp_speedup",
+    "tp_token_identity", "tp_compile_per_rank", "tp_single_compile_count",
+    "tp_ranks", "tp_sessions", "tp_new_tokens", "tp_error",
+)
+
+
+def _tp_child(smoke: bool) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import faulthandler
+
+    faulthandler.dump_traceback_later(max(60.0, JAX_TIMEOUT_S), exit=True)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # cordumlint: disable=CL002 -- older jax without the config key; env var governs
+        pass
+    print(json.dumps(asyncio.run(_bench_tp(smoke))))
+
+
+async def _bench_tp(smoke: bool) -> dict:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from cordum_tpu.models import llama
+    from cordum_tpu.serving.backend import LlamaServingBackend
+    from cordum_tpu.serving.engine import GenRequest, ServingEngine
+    from cordum_tpu.serving.shard import ServingGangGroup
+
+    async def run_blocking(fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(), dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    sessions = 4 if smoke else 8
+    n_new = 8 if smoke else 24
+    prompts = [
+        [(7 * i + 3 * j + 1) % cfg.vocab_size for j in range(9 + i % 4)]
+        for i in range(sessions)
+    ]
+
+    async def serve(backend) -> tuple[list[list[int]], float]:
+        # prefix cache off: the oracle run must prefill every prompt in
+        # full, same as the gang's replayed entry stream
+        eng = ServingEngine(backend, run_blocking=run_blocking,
+                            max_new_tokens_cap=max(64, n_new),
+                            prefix_cache=False)
+        t0 = time.perf_counter()
+        outs = await asyncio.gather(*[
+            eng.submit(GenRequest(prompt=p, max_new_tokens=n_new,
+                                  stream=False), job_id=f"tp-{i}")
+            for i, p in enumerate(prompts)
+        ])
+        dt = time.perf_counter() - t0
+        await eng.stop()
+        return [o["tokens"] for o in outs], (sessions * n_new) / max(dt, 1e-9)
+
+    single = LlamaServingBackend(cfg, num_pages=96, page_size=8,
+                                 params_provider=lambda: params)
+    gang = ServingGangGroup(cfg, tp=2, num_pages=96, page_size=8,
+                            params_provider=lambda: params)
+    toks_single, rate_single = await serve(single)
+    toks_gang, rate_gang = await serve(gang)
+    return {
+        "tp_ranks": 2,
+        "tp_sessions": sessions,
+        "tp_new_tokens": sessions * n_new,
+        "tp_tokens_per_sec": round(rate_gang, 1),
+        "tp_single_tokens_per_sec": round(rate_single, 1),
+        "tp_speedup": round(rate_gang / rate_single, 3) if rate_single else 0.0,
+        "tp_token_identity": 1 if toks_gang == toks_single else 0,
+        "tp_compile_per_rank": max(gang.compiled_per_rank()),
+        "tp_single_compile_count": single.compiled_programs(),
+        "tp_error": "",
+    }
+
+
+def bench_tp(*, smoke: bool = False) -> dict:
+    """Run the TP serving bench in a child process (it must force the
+    8-device CPU host platform before jax initializes; the parent may
+    already hold an initialized single-device backend)."""
+    fail = {"tp_tokens_per_sec": 0.0, "tp_speedup": 0.0,
+            "tp_token_identity": 0.0, "tp_compile_per_rank": 99.0}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--tp-child"]
+            + (["smoke"] if smoke else []),
+            capture_output=True, text=True, timeout=max(600.0, JAX_TIMEOUT_S),
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        line = (proc.stdout.strip().splitlines() or [""])[-1]
+        child = json.loads(line) if line.startswith("{") else {}
+        if not child:
+            tail = (proc.stderr or proc.stdout or "")[-600:]
+            return {**fail, "tp_error": f"tp child rc={proc.returncode}: {tail}"}
+        return {k: child[k] for k in _TP_KEYS if k in child}
+    except subprocess.TimeoutExpired:
+        return {**fail, "tp_error": "tp child timed out"}
+    except Exception as ex:  # noqa: BLE001 - bench must report, not crash
+        return {**fail, "tp_error": f"{type(ex).__name__}: {ex}"[:300]}
+
+
+# ---------------------------------------------------------------------------
 # TPU compute benches — run via `python bench.py --jax-child [tpu|cpu]` in a
 # subprocess so a wedged TPU grant / crashed PJRT client can't hang the
 # control-plane benches. The child prints ONE json line.
@@ -2849,6 +2973,20 @@ def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--gang-child":
         _gang_child("smoke" in sys.argv[2:])
         return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--tp-child":
+        _tp_child("smoke" in sys.argv[2:])
+        return
+    if "--tp" in sys.argv:
+        # sharded serving gang mode (ISSUE 20): the same session set on a
+        # TP=2 in-process gang vs a single-rank worker — token identity,
+        # one compiled ragged program per rank, same-run wall ratio.  One
+        # JSON line, same tp_* keys as the full bench so bench_floor.json
+        # gates both surfaces.
+        out = {"metric": "tp_tokens_per_sec", "unit": "tokens/s"}
+        out.update(bench_tp(smoke="--smoke" in sys.argv))
+        out["value"] = out.get("tp_tokens_per_sec", 0.0)
+        print(json.dumps(out))
+        return
     if "--gang" in sys.argv:
         # gang-scheduling mode (ISSUE 15): barrier-only gang throughput +
         # the three MULTICHIP dryrun flows (dense/moe/MPMD-pipeline) as
@@ -2955,6 +3093,7 @@ def main() -> None:
     storm = asyncio.run(bench_storm(smoke=smoke))
     agents = asyncio.run(bench_agents(smoke=smoke))
     gang = bench_gang(smoke=smoke)
+    tp = bench_tp(smoke=smoke)
     jx = bench_jax(smoke=smoke)
     out = {
         "metric": "scheduled_jobs_per_sec",
@@ -3116,6 +3255,11 @@ def main() -> None:
         # gang_flows_ok floors + the gang_partial_reservations == 0
         # all-or-nothing invariant ceiling live in bench_floor.json)
         **gang,
+        # sharded serving gangs (ISSUE 20): the TP=2 gang vs single-rank
+        # same-run comparison — token identity + one-program-per-rank are
+        # exact contracts, tp_speedup is a 1-core-host collapse guard
+        # (floors/ceiling in bench_floor.json)
+        **tp,
     }
     if smoke:
         out["smoke"] = True
@@ -3130,7 +3274,8 @@ def main() -> None:
     degraded = bool(out["embed_error"] or out["model_error"]
                     or out["batched_error"] or out["serving_error"]
                     or out["disagg_error"] or out["chat_error"]
-                    or out["spec_error"] or out.get("gang_error"))
+                    or out["spec_error"] or out.get("gang_error")
+                    or out.get("tp_error"))
     out["degraded"] = degraded
     if degraded:
         out["child_traceback"] = jx.get("child_traceback", "")

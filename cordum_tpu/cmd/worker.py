@@ -28,6 +28,9 @@ copy-on-write shared-prefix KV pages; WORKER_SERVING_HIBERNATE_AFTER
 (``serving_hibernate_after_s``, seconds) > 0 tiers cached prefixes idle
 past the threshold into the host-RAM cold arena and pins the session's
 scheduler affinity until the next turn restores them.
+WORKER_SERVING_COLD_TIER=statebus (``serving_cold_tier``) journals the
+cold arena through the statebus KV so hibernated sessions survive a
+worker restart (restored on boot, re-admitted on the next turn).
 Speculative decoding (docs/SERVING.md §Speculative decoding):
 WORKER_SERVING_SPECULATIVE=0 (``serving_speculative``) disables the
 zero-extra-weights n-gram drafter inside the ragged step;
@@ -158,6 +161,8 @@ async def main() -> None:
         ),
         serving_draft_k=_boot.env_int("WORKER_SERVING_DRAFT_K", 0)
         or (pool.serving_draft_k if pool else 0),
+        serving_cold_tier=env.get("WORKER_SERVING_COLD_TIER", "")
+        or (pool.serving_cold_tier if pool else ""),
         # gang scheduling (docs/GANG.md): member jobs rendezvous + run the
         # SPMD/MPMD step program; WORKER_GANG=0 opts the worker out
         gang=env.get("WORKER_GANG", "1") != "0",
@@ -171,6 +176,12 @@ async def main() -> None:
         health_fn=lambda: {**worker.telemetry_health(), **profiler.health()},
     )
     await worker.start()
+    # statebus-backed cold tier: re-populate the mirror from the journal
+    # so sessions hibernated before a restart are restorable here
+    tiering = getattr(worker._serving, "tiering", None)
+    arena = getattr(tiering, "arena", None)
+    if callable(getattr(arena, "load", None)):
+        await arena.load()
     await telemetry.start()
     await profiler.start()
     # SIGTERM drains by default (live-migrate sessions, finish jobs, exit);

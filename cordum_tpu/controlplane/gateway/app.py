@@ -66,6 +66,7 @@ from ...protocol.types import (
     LABEL_BATCH_KEY,
     LABEL_BUS_MSG_ID,
     LABEL_GANG_CHIPS,
+    LABEL_GANG_KIND,
     LABEL_GANG_WORKERS,
     LABEL_OP,
     LABEL_SECRETS_PRESENT,
@@ -565,6 +566,11 @@ class Gateway:
                 chips = 0
             if chips > 0:
                 labels[LABEL_GANG_CHIPS] = str(chips)
+            kind = str(gspec.get("kind", "") or "")
+            if kind:
+                # "serving" routes members into the worker's sharded
+                # serving path (docs/SERVING.md §Sharded serving)
+                labels[LABEL_GANG_KIND] = kind
         meta_doc = body.get("metadata") or {}
         metadata = JobMetadata(
             capability=str(meta_doc.get("capability", "")),

@@ -55,6 +55,7 @@ from ...protocol.types import (
     LABEL_GANG_SIZE,
     TERMINAL_STATES,
     gang_chips,
+    gang_kind,
     gang_workers,
 )
 from ...utils.ids import new_id, now_us
@@ -233,6 +234,7 @@ class GangRecord:
     parent_span_id: str = ""
     n_workers: int = 1
     chips: int = 0
+    kind: str = ""  # "" = training/SPMD default; "serving" = TP serving gang
     state: str = GANG_QUEUED
     members: list[str] = field(default_factory=list)
     ready: set = field(default_factory=set)
@@ -348,6 +350,7 @@ class GangScheduler:
             parent_span_id=parent_span_id,
             n_workers=n,
             chips=gang_chips(req.labels),
+            kind=gang_kind(req.labels),
             extra_ops=list(extra_ops or []),
             pending_fields=dict(pending_fields or {}),
         )
@@ -625,6 +628,18 @@ class GangScheduler:
             "mesh": last.get("mesh"),
             "mode": last.get("mode", "spmd"),
         }
+        if rec.kind == "serving":
+            # serving gangs headline from rank 0 — the leader alone samples,
+            # streams, and counts sessions/tokens (followers only replay)
+            lead = rec.done.get(0, {})
+            doc.update({
+                "kind": "serving",
+                "mode": lead.get("mode", "serving"),
+                "sessions": lead.get("sessions"),
+                "tokens": lead.get("tokens"),
+                "tokens_per_s": lead.get("tokens_per_s"),
+                "steps_done": lead.get("steps"),
+            })
         ptr = await self._mem.put_result(rec.job_id, doc)
         res = JobResult(
             job_id=rec.job_id,
@@ -694,6 +709,7 @@ class GangScheduler:
                 parent_span_id=rec.parent_span_id,
                 n_workers=rec.n_workers,
                 chips=rec.chips,
+                kind=rec.kind,
                 exclude=set(rec.exclude) | set(exclude or ()),
                 count_attempt=count_attempt,
                 pending_fields=dict(rec.pending_fields),
@@ -832,6 +848,7 @@ class GangScheduler:
                 "gang_id": rec.gang_id,
                 "job_id": rec.job_id,
                 "state": rec.state,
+                "kind": rec.kind or "spmd",
                 "workers": rec.n_workers,
                 "chips_per_worker": rec.chips,
                 "members": list(rec.members),
@@ -847,14 +864,15 @@ def render_gang_table(doc: dict) -> str:
     """ASCII gang table for ``cordumctl gangs`` from a /api/v1/gangs doc
     (matches the ``cordumctl capacity`` render style)."""
     rows = doc.get("gangs") or []
-    header = f"{'GANG':<14} {'JOB':<14} {'STATE':<9} {'WORKERS':>7} " \
-             f"{'READY':>5} {'DONE':>4} {'AGE_S':>7}  MEMBERS"
+    header = f"{'GANG':<14} {'JOB':<14} {'STATE':<9} {'KIND':<8} " \
+             f"{'WORKERS':>7} {'READY':>5} {'DONE':>4} {'AGE_S':>7}  MEMBERS"
     lines = [header, "-" * len(header)]
     for g in rows:
         lines.append(
             f"{str(g.get('gang_id', ''))[:12]:<14} "
             f"{str(g.get('job_id', ''))[:12]:<14} "
             f"{str(g.get('state', '')):<9} "
+            f"{str(g.get('kind', '') or 'spmd'):<8} "
             f"{g.get('workers', 0):>7} "
             f"{g.get('ready', 0):>5} "
             f"{g.get('done', 0):>4} "

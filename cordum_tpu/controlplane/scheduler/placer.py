@@ -71,6 +71,22 @@ class ServingPlacer:
         self.placed = 0
         self.fallbacks = 0
 
+    def _gang_topology(self) -> tuple[set, dict]:
+        """(follower worker-ids, {leader worker-id: fused gang row}) from
+        the capacity view's serving-gang fold; empty when no gang beacons
+        (or the view predates the fold — older schedulers keep working)."""
+        followers: set = set()
+        leaders: dict[str, dict] = {}
+        gangs = getattr(self.view, "serving_gangs", None)
+        for row in (gangs() if callable(gangs) else {}).values():
+            leader = row.get("leader", "")
+            if leader:
+                leaders[leader] = row
+            for wid, rank in (row.get("members") or {}).items():
+                if wid != leader and int(rank or 0) > 0:
+                    followers.add(wid)
+        return followers, leaders
+
     def _role(self, hb: Heartbeat) -> str:
         """The worker's serving role: the fresh capacity beacon wins, the
         heartbeat label is the fallback (beacons lag ~2s behind boot)."""
@@ -93,9 +109,18 @@ class ServingPlacer:
         the draft-enabled workers that turn the workload's repetition
         into multi-token verified bursts (docs/SERVING.md §Speculative
         decoding).  Preference, not a filter — when no draft-enabled
-        worker is live, placement degrades to the ordinary pool."""
+        worker is live, placement degrades to the ordinary pool.
+
+        Serving gangs (docs/SERVING.md §Sharded serving) collapse to one
+        routable endpoint: follower ranks are excluded outright (their
+        step budget is slaved to the leader's broadcast), and the leader
+        is weighted by the gang's *fused* capacity row — measured gang
+        decode tokens/s × min-of-ranks KV-page headroom — so a faster
+        gang measurably out-draws a slower one."""
+        followers, gang_rows = self._gang_topology()
         pool = [hb for hb in candidates
-                if not self.view.draining(hb.worker_id)]
+                if not self.view.draining(hb.worker_id)
+                and hb.worker_id not in followers]
         prefill_capable = [
             hb for hb in pool if self._role(hb) != SERVING_ROLE_DECODE
         ]
@@ -117,6 +142,12 @@ class ServingPlacer:
                                                OP_SERVING_PREFILL)
             for hb in pool
         }
+        for wid, row in gang_rows.items():
+            # a gang leader's routable rate is the fused gang row, not its
+            # solo prefill history (which predates — or never saw — the gang)
+            rate = float(row.get("tokens_per_s", 0.0) or 0.0)
+            if rate > 0:
+                rates[wid] = rate
         measured = sorted(r for r in rates.values() if r > 0)
         if not measured:
             # no prefill row measured anywhere: nothing analytic to say
@@ -126,10 +157,17 @@ class ServingPlacer:
         weights: dict[str, float] = {}
         for hb in pool:
             base = rates[hb.worker_id] or median
-            kv = self.view.kv_pages(hb.worker_id)
-            total = float(kv.get("pages_total", 0) or 0)
+            row = gang_rows.get(hb.worker_id)
+            if row is not None:
+                # min-of-ranks headroom: the gang stalls on its fullest rank
+                total = float(row.get("pages_total_min", 0) or 0)
+                free = float(row.get("pages_free_min", 0) or 0)
+            else:
+                kv = self.view.kv_pages(hb.worker_id)
+                total = float(kv.get("pages_total", 0) or 0)
+                free = float(kv.get("pages_free", 0) or 0)
             if total > 0:
-                headroom = float(kv.get("pages_free", 0) or 0) / total
+                headroom = free / total
             else:
                 headroom = 1.0  # arena unknown: rate alone decides
             w = base * headroom

@@ -106,6 +106,11 @@ class Pool:
     # engine default)
     serving_speculative: bool = True
     serving_draft_k: int = 0
+    # cold-arena backing store for hibernated sessions: "" (host RAM only,
+    # lost on restart) or "statebus" (journaled to the statebus KV so a
+    # restarted worker restores its hibernated records —
+    # docs/SERVING.md §Session tiering)
+    serving_cold_tier: str = ""
 
 
 @dataclass
@@ -175,6 +180,7 @@ def parse_pool_config(doc: dict, *, source: str = "pools") -> PoolConfig:
             serving_prefix_cache=bool(p.get("serving_prefix_cache", True)),
             serving_speculative=bool(p.get("serving_speculative", True)),
             serving_draft_k=int(p.get("serving_draft_k") or 0),
+            serving_cold_tier=str(p.get("serving_cold_tier") or ""),
             serving_hibernate_after_s=float(
                 p.get("serving_hibernate_after_s") or 0.0
             ),

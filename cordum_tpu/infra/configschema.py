@@ -79,6 +79,10 @@ POOLS_SCHEMA: dict[str, Any] = {
                         "serving_speculative": {"type": "boolean"},
                         "serving_draft_k": _NONNEG_INT,
                         "serving_hibernate_after_s": _NONNEG,
+                        # cold-arena backing store: "" = host RAM only,
+                        # "statebus" = journaled to the statebus KV so
+                        # hibernated sessions survive a worker restart
+                        "serving_cold_tier": {"enum": ["statebus", ""]},
                     },
                     "additionalProperties": False,
                 }],

@@ -659,6 +659,24 @@ class Metrics:
             "Worker-side wait from member dispatch to barrier passage",
             buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
         )
+        # sharded serving gangs (docs/SERVING.md §Sharded serving): one
+        # session set running tensor-parallel over a gang of workers
+        self.serving_gang_steps = Counter(
+            "cordum_serving_gang_steps_total",
+            "Ragged steps on serving-gang members, by role (lead = rank "
+            "0's sampled step + broadcast; replay = a follower replaying "
+            "the broadcast batch against its head shard)",
+        )
+        self.serving_gang_members = Gauge(
+            "cordum_serving_gang_members",
+            "Members of the serving gang this worker currently belongs "
+            "to (0 = not serving in a gang), labeled by gang id",
+        )
+        self.serving_gang_stream_tokens = Counter(
+            "cordum_serving_gang_stream_tokens_total",
+            "Tokens streamed to clients by serving-gang rank 0 — the ONLY "
+            "rank that may publish stream packets (rank-0 ownership rule)",
+        )
         self.slo_burn_rate = Gauge(
             "cordum_slo_burn_rate",
             "SLO error-budget burn rate per objective and window "

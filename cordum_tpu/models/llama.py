@@ -130,6 +130,29 @@ def shard_params(params: Params, cfg: LlamaConfig, mesh: Mesh) -> Params:
     )
 
 
+#: KV page arenas shard by attention head — axis 3 of
+#: ``[L, num_pages, page_size, kvh, hd]`` — matching the column-parallel
+#: wk/wv layout, so the ragged step's page writes and gathers stay local to
+#: each TP rank (docs/SERVING.md §Sharded serving).
+KV_ARENA_SPEC = P(None, None, None, AXIS_TP, None)
+
+
+def shard_serving_state(
+    params: Params, k_pages: jax.Array, v_pages: jax.Array,
+    cfg: LlamaConfig, mesh: Mesh,
+) -> tuple[Params, jax.Array, jax.Array]:
+    """Place serving state onto a TP mesh: weights per :func:`param_specs`,
+    both page arenas split over ``kvh`` (:data:`KV_ARENA_SPEC`).  On a
+    size-1 mesh (the CPU-CI full-replica fallback) every spec degenerates
+    to a trivial placement and this is a no-op device_put."""
+    arena = NamedSharding(mesh, KV_ARENA_SPEC)
+    return (
+        shard_params(params, cfg, mesh),
+        jax.device_put(k_pages, arena),
+        jax.device_put(v_pages, arena),
+    )
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -374,6 +397,8 @@ def ragged_step(
     token_seq: jax.Array,
     out_idx: jax.Array,
     cfg: LlamaConfig,
+    *,
+    sample_logits: bool = True,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One ragged mixed prefill+decode step over the paged KV cache — the
     Ragged Paged Attention entry point (PAPERS.md): a single XLA program
@@ -409,7 +434,15 @@ def ragged_step(
     page, and no token can reach another sequence's pages because the
     gather walks only its own page-table row.  (This is the gather-based
     jnp formulation that runs anywhere; a Pallas kernel walking the page
-    table in VMEM is the TPU upgrade path.)"""
+    table in VMEM is the TPU upgrade path.)
+
+    ``sample_logits`` is a STATIC flag for serving-gang followers
+    (docs/SERVING.md §Sharded serving): rank 0 alone owns sampling, so
+    follower ranks compile with ``sample_logits=False`` and get a program
+    whose lm_head projection + argmax are dead-code-eliminated — they still
+    produce byte-identical K/V arena updates (the writes depend only on the
+    transformer stack), but return an all-zeros token buffer nothing
+    reads."""
     t_buf = tokens.shape[0]
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     ps = k_pages.shape[2]
@@ -448,6 +481,10 @@ def ragged_step(
     # non-draft sampling reads ``preds[out_idx]`` and gets exactly the
     # tokens the sequence-final projection produced; padding rows project
     # too but nothing reads them.
+    if not sample_logits:
+        # follower ranks: K/V writes above are the whole job — skip the
+        # [T, V] projection entirely (static flag → XLA never emits it)
+        return jnp.zeros((t_buf,), jnp.int32), k_pages, v_pages
     logits = x[:, 0] @ params["lm_head"]  # [T, V]
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
 

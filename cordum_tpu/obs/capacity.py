@@ -298,6 +298,7 @@ class CapacityView:
                 "rows": {}, "started_at_us": snap.started_at_us, "last": 0.0,
                 "kv_pages": {}, "occupancy": {},
                 "serving_role": "", "draining": False,
+                "serving_gang": {},
             }
         w["last"] = self.clock()
         for key, row in (block.get("rows") or {}).items():
@@ -322,6 +323,11 @@ class CapacityView:
         if isinstance(role, str):
             w["serving_role"] = role
         w["draining"] = bool(block.get("draining", False))
+        # serving-gang membership (docs/SERVING.md §Sharded serving): the
+        # block rides every beacon while the worker is a gang member and
+        # DISAPPEARS when the gang ends, so absence clears the fold
+        sg = block.get("serving_gang")
+        w["serving_gang"] = dict(sg) if isinstance(sg, dict) else {}
 
     def _fresh(self, worker_id: str) -> Optional[dict]:
         w = self._workers.get(worker_id)
@@ -401,6 +407,48 @@ class CapacityView:
                 out[wid] = r
         return out
 
+    def serving_gang(self, worker_id: str) -> dict:
+        """The worker's fresh serving-gang membership block; {} = not a
+        gang member (or stale)."""
+        w = self._fresh(worker_id)
+        return dict(w.get("serving_gang") or {}) if w is not None else {}
+
+    def serving_gangs(self) -> dict[str, dict]:
+        """gang_id → ONE fused capacity row per live serving gang, folded
+        from every fresh member's beacon (docs/SERVING.md §Sharded
+        serving): the leader (rank 0) contributes the measured aggregate
+        decode tokens/s — the fused step throughput IS rank 0's, every
+        rank advances in lock-step — and page headroom fuses min-of-ranks
+        (a gang admits only what its tightest arena can hold)."""
+        out: dict[str, dict] = {}
+        for wid in list(self._workers):
+            w = self._fresh(wid)
+            if w is None:
+                continue
+            sg = w.get("serving_gang") or {}
+            gid = str(sg.get("gang_id", "") or "")
+            if not gid:
+                continue
+            g = out.setdefault(gid, {
+                "gang_id": gid, "size": int(sg.get("size", 0) or 0),
+                "leader": "", "members": {}, "tokens_per_s": 0.0,
+                "pages_free_min": None, "pages_total_min": None,
+            })
+            try:
+                rank = int(sg.get("rank", -1))
+            except (TypeError, ValueError):
+                rank = -1
+            g["members"][wid] = rank
+            if rank == 0:
+                g["leader"] = wid
+                g["tokens_per_s"] = float(sg.get("tokens_per_s", 0.0) or 0.0)
+            for src, dst in (("pages_free", "pages_free_min"),
+                             ("pages_total", "pages_total_min")):
+                v = sg.get(src)
+                if isinstance(v, (int, float)):
+                    g[dst] = v if g[dst] is None else min(g[dst], v)
+        return out
+
 
 # ---------------------------------------------------------------------------
 # `cordumctl capacity` rendering (pure function so tests cover it offline)
@@ -477,10 +525,36 @@ def render_worker_table(workers: dict) -> list[str]:
     return _render_rows(_WORKER_COLS, rows) if rows else []
 
 
+_GANG_COLS = (
+    ("gang", "gang"), ("size", "size"), ("tok/s", "tokens_per_s"),
+    ("kv_free_min", "kv_free_min"), ("members", "members"),
+)
+
+
+def render_serving_gang_table(gangs: list) -> list[str]:
+    """ONE fused line per serving gang (docs/SERVING.md §Sharded serving):
+    aggregate decode tokens/s, min-of-ranks page headroom, and the member
+    ranks — instead of N unrelated worker rows.  [] when no gang is live."""
+    rows = []
+    for g in sorted(gangs or [], key=lambda g: str(g.get("gang_id", ""))):
+        members = g.get("members") or {}
+        rows.append({
+            "gang": str(g.get("gang_id", "")),
+            "size": str(g.get("size", len(members))),
+            "tokens_per_s": f"{g.get('tokens_per_s', 0.0):.1f}",
+            "kv_free_min": str(g.get("pages_free_min", "-")),
+            "members": " ".join(
+                f"{wid}:{rank}" for wid, rank in
+                sorted(members.items(), key=lambda kv: kv[1])),
+        })
+    return _render_rows(_GANG_COLS, rows) if rows else []
+
+
 def render_capacity_table(doc: dict) -> str:
     """ASCII op × worker throughput table for ``cordumctl capacity`` from a
     ``GET /api/v1/capacity`` document, with a per-worker serving-state
-    section (KV-page headroom, decode occupancy, role, draining)."""
+    section (KV-page headroom, decode occupancy, role, draining) and one
+    fused row per live serving gang."""
     matrix = doc.get("matrix") or []
     ops = doc.get("ops") or {}
     head = "cordum capacity — {w} worker(s), {r} profile row(s)".format(
@@ -489,6 +563,9 @@ def render_capacity_table(doc: dict) -> str:
         head += "  |  " + "  ".join(
             f"{op}={v}/s" for op, v in sorted(ops.items()))
     worker_lines = render_worker_table(doc.get("workers") or {})
+    gang_lines = render_serving_gang_table(doc.get("serving_gangs") or [])
+    if gang_lines:
+        worker_lines = [*worker_lines, "", "serving gangs:", *gang_lines]
     if not matrix:
         return "\n".join(
             [head, *worker_lines, "(no capacity profiles reported yet)"])

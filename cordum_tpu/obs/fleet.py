@@ -171,7 +171,7 @@ class _InstanceState:
         self.capacity_meta = {
             k: block.get(k)
             for k in ("device_kind", "ts_us", "seq", "kv_pages", "occupancy",
-                      "serving_role", "draining")
+                      "serving_role", "draining", "serving_gang")
             if block.get(k) is not None
         }
         for key, row in (block.get("rows") or {}).items():
@@ -510,7 +510,8 @@ class FleetAggregator:
                 "age_s": age,
                 "rows": len(inst.capacity_rows),
             }
-            for extra in ("kv_pages", "occupancy", "serving_role", "draining"):
+            for extra in ("kv_pages", "occupancy", "serving_role", "draining",
+                          "serving_gang"):
                 if meta.get(extra) is not None:
                     wdoc[extra] = meta[extra]
             workers[inst.instance] = wdoc
@@ -524,11 +525,43 @@ class FleetAggregator:
                 if fresh:
                     op = str(row.get("op", ""))
                     ops[op] = ops.get(op, 0.0) + float(row.get("items_per_s", 0.0))
+        # serving gangs fuse to ONE row per gang (docs/SERVING.md §Sharded
+        # serving): rank 0's measured step throughput is the gang's — every
+        # rank advances in lock-step — and page headroom is min-of-ranks.
+        # Folded over ALL worker instances (a member with no profile rows
+        # yet still beacons its membership).
+        gangs: dict[str, dict] = {}
+        for inst in self._instances.values():
+            sg = inst.capacity_meta.get("serving_gang")
+            if not isinstance(sg, dict) or not self._healthy(inst, now):
+                continue
+            gid = str(sg.get("gang_id", "") or "")
+            if not gid:
+                continue
+            g = gangs.setdefault(gid, {
+                "gang_id": gid, "size": int(sg.get("size", 0) or 0),
+                "leader": "", "members": {}, "tokens_per_s": 0.0,
+                "pages_free_min": None, "pages_total_min": None,
+            })
+            try:
+                rank = int(sg.get("rank", -1))
+            except (TypeError, ValueError):
+                rank = -1
+            g["members"][inst.instance] = rank
+            if rank == 0:
+                g["leader"] = inst.instance
+                g["tokens_per_s"] = float(sg.get("tokens_per_s", 0.0) or 0.0)
+            for src, dst in (("pages_free", "pages_free_min"),
+                             ("pages_total", "pages_total_min")):
+                v = sg.get(src)
+                if isinstance(v, (int, float)):
+                    g[dst] = v if g[dst] is None else min(g[dst], v)
         return {
             "ts_us": now_us(),
             "workers": workers,
             "matrix": matrix,
             "ops": {op: round(v, 2) for op, v in sorted(ops.items())},
+            "serving_gangs": [gangs[k] for k in sorted(gangs)],
         }
 
     def gangs_doc(self) -> dict:

@@ -455,11 +455,17 @@ class GangMsg(WireModel):
       or cotangent (backward) tensor for ``to_rank``, addressed by the
       unique ``tag`` (``fwd:<step>:<microbatch>`` / ``bwd:...``); ``data``
       is the raw float32 buffer, ``shape`` its dims.
+    * ``kind="step"`` — serving-gang replay traffic (docs/SERVING.md
+      §Sharded serving): rank 0 broadcasts the ragged-step entry batch it
+      just ran so every follower replays the identical program against its
+      head shard; ``stats`` carries the serialized ``StepEntry`` rows and a
+      monotonic ``seq`` (followers replay in order), plus ``final=True`` on
+      the shutdown marker.
     """
 
     gang_id: str = ""
     job_id: str = ""
-    kind: str = ""  # ready | abort | done | stage
+    kind: str = ""  # ready | abort | done | stage | step
     rank: int = -1
     to_rank: int = -1  # stage messages: the addressed member
     worker_id: str = ""
@@ -1018,6 +1024,7 @@ def payload_session_key(payload: Any) -> str:
 # submit-time labels (gateway ← payload["gang"])
 LABEL_GANG_WORKERS = "cordum.gang_workers"  # members requested (all-or-nothing)
 LABEL_GANG_CHIPS = "cordum.gang_chips"  # min chips each member must own
+LABEL_GANG_KIND = "cordum.gang_kind"  # "" (train) | "serving" (TP serving gang)
 
 # dispatch-time labels (gang scheduler → members)
 LABEL_GANG_ID = "cordum.gang_id"
@@ -1055,3 +1062,10 @@ def gang_chips(labels: Optional[dict]) -> int:
         return max(0, int((labels or {}).get(LABEL_GANG_CHIPS, "0") or 0))
     except (TypeError, ValueError):
         return 0
+
+
+def gang_kind(labels: Optional[dict]) -> str:
+    """The gang's workload kind ("" = training/generic, "serving" = a
+    tensor-parallel serving gang; docs/SERVING.md §Sharded serving)."""
+    v = (labels or {}).get(LABEL_GANG_KIND, "")
+    return v if isinstance(v, str) else ""

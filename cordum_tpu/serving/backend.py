@@ -71,6 +71,14 @@ class LlamaServingBackend:
     # natively — the engine gates its drafter on this capability flag
     # (test fakes without it keep the legacy single-sample step contract)
     supports_draft = True
+    # sharded serving (serving/shard.py): follower ranks set this False and
+    # compile a program whose lm_head is dead-code-eliminated — rank 0
+    # alone pays sampling (docs/SERVING.md §Sharded serving)
+    sample_output = True
+    # observation tap: called with the entry list after every successful
+    # step — the serving-gang leader broadcasts it so followers replay the
+    # identical program against their head shards
+    on_step: Optional[Callable[[list["StepEntry"]], None]] = None
 
     def __init__(
         self,
@@ -132,16 +140,27 @@ class LlamaServingBackend:
         self._k_pages, self._v_pages = llama.init_kv_pages(
             self.cfg, self.num_pages, self.page_size
         )
+        # sharded-serving hook: a subclass may re-place params and arenas
+        # onto a TP mesh (NamedSharding) before the program compiles
+        self._params, self._k_pages, self._v_pages = self._place_state(
+            self._params, self._k_pages, self._v_pages
+        )
         cfg = self.cfg
+        sample = bool(self.sample_output)
         # donate the page arenas on real accelerators so the in-place
         # update never copies the arena; CPU jax spams donation warnings
         donate = (jax.default_backend() != "cpu")
         self._ragged_jit = jax.jit(
             lambda p, kp, vp, toks, pos, pt, ts, oi: llama.ragged_step(
-                p, kp, vp, toks, pos, pt, ts, oi, cfg
+                p, kp, vp, toks, pos, pt, ts, oi, cfg, sample_logits=sample
             ),
             donate_argnums=(1, 2) if donate else (),
         )
+
+    def _place_state(self, params: Any, k_pages: Any, v_pages: Any):
+        """Device-placement hook (identity here).  ShardedServingBackend
+        overrides it to apply the TP NamedSharding layout."""
+        return params, k_pages, v_pages
 
     def compiled_programs(self) -> int:
         return len(self._compiled_shapes)
@@ -229,6 +248,8 @@ class LlamaServingBackend:
                 res.append(int(out[hi - 1]))
             else:
                 res.append(None)
+        if self.on_step is not None:
+            self.on_step(entries)
         return res
 
     # ------------------------------------------------------------------
@@ -276,6 +297,14 @@ class LlamaServingBackend:
         self._ensure()
         from ..models import llama
 
+        if any("heads" in rec for rec in records):
+            # per-rank records from a serving-gang source (docs/SERVING.md
+            # §Sharded serving): each rank exported its head slice of every
+            # page — merge the slices back into full-head records, so ANY
+            # backend (single-rank or gang) imports a gang export unchanged
+            from .shard import merge_rank_records
+
+            records = merge_rank_records(records)
         ids, blocks = [], []
         for rec in records:
             o = int(rec["i"])

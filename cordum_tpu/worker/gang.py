@@ -39,6 +39,7 @@ list).  The member then:
 from __future__ import annotations
 
 import asyncio
+import collections
 import contextlib
 import time
 from typing import Any
@@ -52,8 +53,10 @@ from ..protocol.types import (
     GangMsg,
     JobRequest,
     LABEL_GANG_ID,
+    LABEL_GANG_MEMBERS,
     LABEL_GANG_RANK,
     LABEL_GANG_SIZE,
+    SERVING_OPS,
 )
 
 DEFAULT_RENDEZVOUS_TIMEOUT_S = 10.0
@@ -83,12 +86,19 @@ class _GangSession:
         self.abort = asyncio.Event()
         self.abort_reason = ""
         self._mail: dict[str, asyncio.Future] = {}
+        # serving-gang replay stream (kind="step"): rank 0's broadcast
+        # entry batches, drained in seq order by the follower loop
+        self.steps: collections.deque[GangMsg] = collections.deque()
+        self.step_event = asyncio.Event()
 
     def on_msg(self, msg: GangMsg) -> None:
         if msg.kind == "ready":
             self.ready.add(msg.rank)
             if len(self.ready) >= self.size:
                 self.barrier.set()
+        elif msg.kind == "step":
+            self.steps.append(msg)
+            self.step_event.set()
         elif msg.kind == "abort":
             self.abort_reason = self.abort_reason or (msg.reason or "abort")
             self.abort.set()
@@ -141,6 +151,10 @@ class GangRunner:
         self.beacon_interval_s = beacon_interval_s
         self._sessions: dict[str, _GangSession] = {}
         self._tasks: set[asyncio.Task] = set()
+        # live serving gangs this worker is a member of, keyed by gang id —
+        # the worker's telemetry beacon folds these into the capacity plane
+        # so the fleet renders ONE fused row per gang (obs/capacity.py)
+        self._serving_gangs: dict[str, dict] = {}
         # done-report cache: a member packet redelivered after completion
         # republishes the recorded GangMsg instead of re-running the step
         # program (the worker-level completed-result idempotence, gang-shaped)
@@ -353,6 +367,9 @@ class GangRunner:
     ) -> dict:
         payload = payload if isinstance(payload, dict) else {}
         op = str(payload.get("op", "train"))
+        gang_stanza = payload.get("gang") if isinstance(payload.get("gang"), dict) else {}
+        if op in SERVING_OPS or str(gang_stanza.get("kind", "")) == "serving":
+            return await self._run_serving(session, ctx, payload)
         if op == "train":
             mesh_req = payload.get("mesh") or {}
             pp = int(mesh_req.get("pp", 1) or 1)
@@ -403,6 +420,277 @@ class GangRunner:
             await asyncio.sleep(0.02)
         return {"op": "gang_test", "mode": "spin", "rank": session.rank,
                 "spin_s": spin_s}
+
+    # ------------------------------------------------------------------
+    # serving gangs: tensor-parallel ragged serving over the gang
+    # (docs/SERVING.md §Sharded serving)
+    # ------------------------------------------------------------------
+    def serving_gang_doc(self) -> dict:
+        """This worker's live serving-gang membership for the telemetry
+        beacon (empty dict = not serving in a gang).  Rank 0's doc carries
+        the measured fused throughput; follower docs carry only identity +
+        their arena headroom (the fleet fuses min-of-ranks)."""
+        for doc in self._serving_gangs.values():
+            out = dict(doc)
+            cb = out.pop("_live", None)
+            if callable(cb):
+                with contextlib.suppress(Exception):
+                    out.update(cb())
+            return out
+        return {}
+
+    def _serving_backend(self, session: _GangSession, payload: dict):
+        """Build this rank's sharded backend from the payload's sizing
+        knobs.  Every rank derives IDENTICAL params (same seed, same cfg) —
+        on real hardware NamedSharding keeps only the local head slice
+        resident; on the 1-chip CI fallback each rank holds a replica."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from ..models import llama
+        from ..serving.shard import ShardedServingBackend
+
+        dtype_name = str(payload.get("dtype", "float32") or "float32")
+        cfg = dataclasses.replace(
+            llama.LlamaConfig.tiny(),
+            dtype=jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32,
+        )
+        max_seqs = max(1, int(payload.get("max_sessions", 4) or 4))
+        return ShardedServingBackend(
+            cfg,
+            rank=session.rank,
+            tp=session.size,
+            num_pages=max(2, int(payload.get("cache_pages", 64) or 64)),
+            page_size=max(1, int(payload.get("page_size", 16) or 16)),
+            max_seqs=max_seqs,
+            max_batch_tokens=max_seqs + max(
+                1, int(payload.get("prefill_budget", 16) or 16)),
+            seed=int(payload.get("seed", 0) or 0),
+        )
+
+    async def _run_serving(
+        self, session: _GangSession, ctx, payload: dict
+    ) -> dict:
+        """One serving-gang member.  Rank 0 runs the REAL serving engine
+        (admission, session registry, token streaming) over its shard and
+        broadcasts every ragged step's entry batch as ``kind="step"``;
+        follower ranks replay the identical batches against their shards —
+        same program, same arena trajectory, no lm_head (docs/SERVING.md
+        §Sharded serving)."""
+        labels = (ctx.request.labels or {})
+        members = [m for m in labels.get(LABEL_GANG_MEMBERS, "").split(",") if m]
+        backend = self._serving_backend(session, payload)
+        metrics = getattr(self.worker, "gang_metrics", None)
+        doc: dict[str, Any] = {
+            "gang_id": session.gang_id,
+            "rank": session.rank,
+            "size": session.size,
+            "members": members,
+            "pages_total": backend.num_pages,
+        }
+        self._serving_gangs[session.gang_id] = doc
+        if metrics is not None:
+            metrics.serving_gang_members.set(
+                float(session.size), gang=session.gang_id)
+        try:
+            if session.rank == 0:
+                return await self._serve_leader(session, ctx, payload, backend)
+            return await self._serve_follower(session, ctx, backend)
+        finally:
+            # linger_s keeps the fused row visible after the job finishes
+            # (platform_smoke scrapes capacity while the gang is winding
+            # down); the abort latch cuts the linger short
+            linger = float(payload.get("linger_s", 0.0) or 0.0)
+            deadline = time.monotonic() + linger
+            while time.monotonic() < deadline and not session.abort.is_set():
+                await asyncio.sleep(0.05)
+            self._serving_gangs.pop(session.gang_id, None)
+            if metrics is not None:
+                metrics.serving_gang_members.set(0.0, gang=session.gang_id)
+
+    async def _serve_leader(
+        self, session: _GangSession, ctx, payload: dict, backend
+    ) -> dict:
+        from ..serving.engine import GenRequest as EngineGenRequest
+        from ..serving.engine import ServingEngine
+        from ..serving.shard import entry_to_wire
+
+        worker = self.worker
+        loop = asyncio.get_running_loop()
+        metrics = getattr(worker, "gang_metrics", None)
+        seq = 0
+
+        def _broadcast(entries) -> None:
+            # called from the step's executor thread, after the device call
+            # lands: ship the EXACT entry batch so followers replay the
+            # same compiled program.  Blocking on the publish keeps the
+            # replay stream ordered and applies natural backpressure.
+            nonlocal seq
+            msg = GangMsg(
+                gang_id=session.gang_id, job_id=session.job_id, kind="step",
+                rank=0, worker_id=worker.worker_id,
+                stats={"seq": seq,
+                       "entries": [entry_to_wire(e) for e in entries]},
+            )
+            seq += 1
+            asyncio.run_coroutine_threadsafe(
+                self._publish(session.gang_id, msg, session.trace_id), loop
+            ).result()
+            if metrics is not None:
+                metrics.serving_gang_steps.inc(role="lead")
+
+        backend.on_step = _broadcast
+        engine = ServingEngine(
+            backend,
+            run_blocking=worker.run_in_executor,
+            max_sessions=backend.max_seqs,
+            max_new_tokens_cap=int(payload.get("max_new_tokens", 16) or 16),
+            metrics=metrics,
+            tracer=worker.tracer,
+            # CoW page copies happen outside step() and would not replay on
+            # followers — the gang engine runs with prefix sharing off (the
+            # single-worker engines keep it; a broadcast copy_page protocol
+            # is the upgrade path)
+            prefix_cache=False,
+            speculative=bool(payload.get("speculative", False)),
+            draft_k=int(payload.get("draft_k", 0) or 0) or 4,
+        )
+        prompts = payload.get("prompts")
+        if not isinstance(prompts, list) or not prompts:
+            one = payload.get("prompt") or payload.get("tokens") or [1, 2, 3]
+            prompts = [one]
+        prompts = [[int(t) for t in p] for p in prompts if p][: backend.max_seqs]
+        max_new = int(payload.get("max_new_tokens", 16) or 16)
+        live = {"t0": time.monotonic(), "tokens": 0}
+
+        def _live() -> dict:
+            free = engine.allocator.free_pages
+            dt = max(1e-6, time.monotonic() - live["t0"])
+            return {"pages_free": free,
+                    "tokens_per_s": round(live["tokens"] / dt, 3)}
+
+        self._serving_gangs[session.gang_id]["_live"] = _live
+
+        def _sink(first: bool):
+            base = worker._token_sink(
+                session.job_id,
+                EngineGenRequest(prompt=[], max_new_tokens=max_new),
+            ) if first else None
+
+            async def sink(new_tokens, n_generated, done):
+                live["tokens"] += len(new_tokens)
+                if metrics is not None and new_tokens:
+                    metrics.serving_gang_stream_tokens.inc(
+                        len(new_tokens), rank="0")
+                if base is not None:
+                    await base(new_tokens, n_generated, done)
+
+            return sink
+
+        async def _drive() -> list[dict]:
+            subs = [
+                engine.submit(
+                    EngineGenRequest(
+                        prompt=p, max_new_tokens=max_new,
+                        stream=(i == 0),
+                    ),
+                    job_id=session.job_id if i == 0
+                    else f"{session.job_id}#{i}",
+                    trace_id=session.trace_id,
+                    on_tokens=_sink(first=(i == 0)),
+                )
+                for i, p in enumerate(prompts)
+            ]
+            return await asyncio.gather(*subs)
+
+        drive = asyncio.ensure_future(_drive())
+        abort_w = asyncio.ensure_future(session.abort.wait())
+        cancel_w = asyncio.ensure_future(ctx.cancelled.wait())
+        try:
+            done, _ = await asyncio.wait(
+                {drive, abort_w, cancel_w},
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if drive not in done:
+                drive.cancel()
+                with contextlib.suppress(asyncio.CancelledError, Exception):
+                    await drive
+                raise GangAborted(session.abort_reason or "cancelled")
+            results = await drive
+        finally:
+            for w in (abort_w, cancel_w):
+                w.cancel()
+            with contextlib.suppress(Exception):
+                await engine.stop()
+            # the shutdown marker releases the follower replay loops
+            with contextlib.suppress(Exception):
+                await self._publish(session.gang_id, GangMsg(
+                    gang_id=session.gang_id, job_id=session.job_id,
+                    kind="step", rank=0, worker_id=worker.worker_id,
+                    stats={"seq": seq, "final": True},
+                ), session.trace_id)
+        elapsed = max(1e-6, time.monotonic() - live["t0"])
+        total = sum(len(r.get("tokens") or []) for r in results)
+        return {
+            "mode": "serving", "rank": 0, "tp": session.size,
+            "sessions": len(results), "tokens": total,
+            "tokens_per_s": round(total / elapsed, 3),
+            "steps": seq, "compiled": backend.compiled_programs(),
+            "results": results,
+        }
+
+    async def _serve_follower(
+        self, session: _GangSession, ctx, backend
+    ) -> dict:
+        """Replay rank 0's entry batches in seq order until the shutdown
+        marker.  The bus preserves per-publisher order, but the loop
+        reorders defensively — a replayed batch must never run early (the
+        arenas would diverge)."""
+        from ..serving.shard import entry_from_wire
+
+        metrics = getattr(self.worker, "gang_metrics", None)
+        expected = 0
+        pending: dict[int, dict] = {}
+        replayed = 0
+        while True:
+            session.check_abort()
+            if ctx.cancelled.is_set():
+                raise GangAborted("cancelled")
+            while session.steps:
+                msg = session.steps.popleft()
+                s = int((msg.stats or {}).get("seq", -1))
+                if s >= expected:
+                    pending[s] = msg.stats or {}
+            progressed = False
+            while expected in pending:
+                stats = pending.pop(expected)
+                expected += 1
+                progressed = True
+                if stats.get("final"):
+                    return {
+                        "mode": "serving", "rank": session.rank,
+                        "tp": session.size, "steps_replayed": replayed,
+                        "compiled": backend.compiled_programs(),
+                    }
+                entries = [entry_from_wire(d)
+                           for d in (stats.get("entries") or [])]
+                if entries:
+                    await self.worker.run_in_executor(
+                        lambda e=entries: backend.step(e))
+                    replayed += 1
+                    if metrics is not None:
+                        metrics.serving_gang_steps.inc(role="replay")
+            if progressed or session.steps:
+                continue
+            session.step_event.clear()
+            if session.steps:
+                continue
+            try:
+                await asyncio.wait_for(
+                    session.step_event.wait(), self.peer_timeout_s)
+            except asyncio.TimeoutError:
+                raise GangAborted(f"peer_timeout:step{expected}") from None
 
     # ------------------------------------------------------------------
     # MPMD pipeline: one stage per worker, activations over the bus

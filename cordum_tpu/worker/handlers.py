@@ -412,6 +412,7 @@ def make_serving_engine(
     hibernate_after_s: float = 0.0,
     speculative: bool = True,
     draft_k: int = 0,
+    cold_tier: str = "",
     metrics=None,
 ):
     """Build the worker's continuous-batching serving engine over a paged
@@ -439,7 +440,7 @@ def make_serving_engine(
         params_provider=params_provider,
         metrics=metrics,
     )
-    return ServingEngine(
+    engine = ServingEngine(
         backend,
         run_blocking=worker.run_in_executor,
         max_sessions=max_sessions,
@@ -455,6 +456,15 @@ def make_serving_engine(
         tracer=worker.tracer,
         capacity=worker.capacity,
     )
+    if cold_tier == "statebus" and engine.tiering is not None:
+        # journal hibernated sessions through the statebus KV so they
+        # survive a restart; cmd.worker awaits arena.load() post-start
+        from ..serving.tiering import StatebusColdTier
+
+        engine.tiering.arena = StatebusColdTier(
+            worker.store.kv, worker_id=worker.worker_id,
+        )
+    return engine
 
 
 def attach_default_tpu_worker(
@@ -475,6 +485,7 @@ def attach_default_tpu_worker(
     serving_hibernate_after_s: float = 0.0,
     serving_speculative: bool = True,
     serving_draft_k: int = 0,
+    serving_cold_tier: str = "",
     gang: bool = True,
     gang_rendezvous_timeout_s: float = 10.0,
     gang_peer_timeout_s: float = 30.0,
@@ -504,6 +515,7 @@ def attach_default_tpu_worker(
             hibernate_after_s=serving_hibernate_after_s,
             speculative=serving_speculative,
             draft_k=serving_draft_k,
+            cold_tier=serving_cold_tier,
             metrics=metrics,
         ))
     if gang:

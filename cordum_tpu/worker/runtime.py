@@ -1214,6 +1214,13 @@ class Worker:
             out["capacity"]["serving_role"] = self.serving_role
             if self._draining:
                 out["capacity"]["draining"] = True
+        if self._gang is not None:
+            # serving-gang membership (docs/SERVING.md §Sharded serving):
+            # rank 0 beacons the fused throughput, followers their arena
+            # headroom — the fleet folds all ranks into ONE capacity row
+            gang_doc = self._gang.serving_gang_doc()
+            if gang_doc:
+                out["capacity"]["serving_gang"] = gang_doc
         if self._draining:
             out["draining"] = True
         return out

@@ -327,6 +327,15 @@ _HEALTHY_SPEC = {
     "spec_compile_count": 1,
 }
 
+# sharded serving gang (TP=2 over the in-process gang group): identity is
+# binary, the compile ceiling is exactly one program per rank, and the
+# speedup floor is a collapse guard only (both ranks time-share the core
+# on 1-2 core CI hosts — see the bench_floor.json commentary)
+_HEALTHY_TP = {
+    "tp_token_identity": 1, "tp_speedup": 0.51,
+    "tp_tokens_per_sec": 15.5, "tp_compile_per_rank": 1,
+}
+
 
 def test_floor_checker_passes_healthy_doc():
     mod = _floor_mod()
@@ -341,7 +350,8 @@ def test_floor_checker_passes_healthy_doc():
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
            **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG,
-           **_HEALTHY_AGENTS, **_HEALTHY_CHAT, **_HEALTHY_SPEC}
+           **_HEALTHY_AGENTS, **_HEALTHY_CHAT, **_HEALTHY_SPEC,
+           **_HEALTHY_TP}
     floors = json.loads((REPO / "bench_floor.json").read_text())
     assert mod.check(doc, floors) == []
 
@@ -362,7 +372,8 @@ def test_floor_checker_fails_regressed_metric(tmp_path):
            "fleet_snapshot_ok": 1.0, "telemetry_overhead_pct": 0.5,
            "capacity_matrix_ok": 1.0, "profiling_overhead_pct": 0.4,
            **_HEALTHY_STORM, **_HEALTHY_DISAGG, **_HEALTHY_GANG,
-           **_HEALTHY_AGENTS, **_HEALTHY_CHAT, **_HEALTHY_SPEC}
+           **_HEALTHY_AGENTS, **_HEALTHY_CHAT, **_HEALTHY_SPEC,
+           **_HEALTHY_TP}
     violations = mod.check(doc, floors)
     assert violations and "value" in violations[0]
     # ceilings guard the other direction (round-trip budget regression)

@@ -1060,6 +1060,78 @@ def main() -> int:
             else:
                 log("14. speculative decoding: skipped (external deployment)")
 
+            # 15. sharded serving gang (docs/SERVING.md §Sharded serving):
+            # one llm.generate job carrying a gang stanza of kind=serving
+            # reserves TWO co-located workers all-or-nothing, rendezvouses
+            # them into a TP=2 gang, and serves the session set tensor-
+            # parallel — rank 0 alone samples and streams, the follower
+            # replays the broadcast ragged entries with lm_head DCE'd.
+            # While the gang lingers post-job, /api/v1/capacity must show
+            # ONE fused row for it (aggregate tokens/s, min-of-ranks page
+            # headroom) instead of two independent worker rows, and the
+            # fleet metrics must show stream tokens from rank 0 ONLY.
+            if not external:
+                def _fleet_txt() -> str:
+                    return httpx.get(f"{API}/metrics?scope=fleet",
+                                     timeout=10.0).text
+
+                motif = [5, 9, 14, 23, 7, 11, 3, 19]
+                r = c.post("/api/v1/jobs", json={
+                    "topic": "job.tpu.generate",
+                    "payload": {"op": "llm.generate",
+                                "gang": {"kind": "serving", "workers": 2},
+                                "prompts": [motif * 2 + [2]],
+                                "max_new_tokens": 12,
+                                "cache_pages": 32, "page_size": 8,
+                                "linger_s": 20.0}})
+                assert r.status_code == 202, r.text
+                gang_job = r.json()["job_id"]
+                # the fused capacity row appears while the gang is live
+                # (the linger window keeps it up past the job result)
+                fused, t0 = [], time.time()
+                while time.time() - t0 < 90:
+                    fused = c.get("/api/v1/capacity").json().get(
+                        "serving_gangs", [])
+                    if fused:
+                        break
+                    time.sleep(0.5)
+                assert len(fused) == 1, fused
+                row = fused[0]
+                assert row["size"] == 2 and len(row["members"]) == 2, row
+                assert sorted(row["members"].values()) == [0, 1], row
+                assert row["leader"] in row["members"], row
+                assert row["pages_total_min"] > 0, row
+                doc = wait_job(c, gang_job, "SUCCEEDED", 120)
+                res = doc["result"]
+                assert res["kind"] == "serving", res
+                lead = res["per_rank"]["0"]
+                follow = res["per_rank"]["1"]
+                assert len(lead["results"][0]["tokens"]) == 12, lead
+                # one ragged program per rank; the follower replayed every
+                # broadcast step and sampled nothing
+                assert lead["compiled"] == 1 and follow["compiled"] == 1, res
+                assert follow["steps_replayed"] == lead["steps"] > 0, res
+                # the gangs table knows the kind (cordumctl gangs)
+                gdoc = c.get("/api/v1/gangs").json()
+                assert any(g.get("kind") == "serving"
+                           for g in gdoc.get("gangs", [])), gdoc
+                # rank 0 alone streamed: the stream-token counter carries
+                # exactly the rank="0" series
+                ranks = set()
+                for ln in _fleet_txt().splitlines():
+                    if ln.startswith(
+                            "cordum_serving_gang_stream_tokens_total{"):
+                        ranks.add(ln.split('rank="')[1].split('"')[0])
+                assert ranks == {"0"}, ranks
+                log(f"15. sharded serving gang: TP=2 gang "
+                    f"({'+'.join(sorted(row['members']))}) served the "
+                    f"session with 1 ragged program per rank, one fused "
+                    f"capacity row ({row['pages_free_min']}/"
+                    f"{row['pages_total_min']} min pages free), stream "
+                    f"packets from rank 0 only")
+            else:
+                log("15. sharded serving gang: skipped (external deployment)")
+
         log("PASS")
         return 0
     finally:
